@@ -1,0 +1,13 @@
+"""raft_stereo_tpu — a TPU-native (JAX/XLA/Pallas) stereo-depth framework.
+
+Re-designs the capabilities of RAFT-Stereo (reference: /root/reference, arXiv
+2109.07547) TPU-first: NHWC layouts, flax modules, `lax.scan` over GRU
+refinement iterations, XLA/Pallas correlation backends, and SPMD data
+parallelism over a `jax.sharding.Mesh`.
+"""
+
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["RaftStereoConfig", "TrainConfig", "__version__"]

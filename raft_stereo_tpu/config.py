@@ -1,0 +1,161 @@
+"""Model / training configuration.
+
+The reference duplicates architecture flags across three argparse entry points
+(reference: train_stereo.py:233-240, evaluate_stereo.py:193-208, demo.py:54-72)
+and a checkpoint can silently mismatch them.  Here the architecture lives in a
+single frozen dataclass that is serialized alongside every checkpoint, so a
+checkpoint is self-describing.
+
+Convention note (documented per SURVEY.md §2 "default-dependent quirks"): the
+reference indexes ``hidden_dims`` coarse→fine in the update block but fine→coarse
+in ``context_zqr_convs`` — invisible because all dims equal 128.  We pick ONE
+convention: ``hidden_dims[0]`` is the FINEST level (1/2^n_downsample resolution),
+``hidden_dims[-1]`` the coarsest.  The torch-checkpoint importer handles the
+reordering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+CORR_BACKENDS = ("reg", "alt", "reg_fused")
+
+# Reference CLI --corr_implementation values → our backends
+# (reference: core/raft_stereo.py:90-100; "alt_cuda" is dead code there).
+_REFERENCE_CORR_ALIASES = {
+    "reg": "reg",
+    "alt": "alt",
+    "reg_cuda": "reg_fused",
+    "alt_cuda": "alt",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftStereoConfig:
+    """Architecture of one RAFT-Stereo model (reference: core/raft_stereo.py:22-44)."""
+
+    # Per-GRU-level hidden state channels, FINE → COARSE
+    # (level 0 = 1/2^n_downsample resolution).
+    hidden_dims: Tuple[int, ...] = (128, 128, 128)
+    # Context dims are aliased to hidden dims in the reference
+    # (core/raft_stereo.py:27); we keep them separate but default-equal.
+    context_dims: Optional[Tuple[int, ...]] = None
+    n_gru_layers: int = 3
+    n_downsample: int = 2          # features at 1/2^n_downsample resolution
+    corr_levels: int = 4
+    corr_radius: int = 4
+    corr_backend: str = "reg"      # one of CORR_BACKENDS
+    shared_backbone: bool = False  # fnet shares the cnet trunk (core/raft_stereo.py:34-39)
+    slow_fast_gru: bool = False    # extra coarse-GRU-only updates per iter
+    mixed_precision: bool = False  # bf16 compute for encoders + update block
+    context_norm: str = "batch"    # cnet norm (reference uses frozen batch norm)
+    fnet_norm: str = "instance"
+    fnet_dim: int = 256
+    # Extension beyond the reference: shard the W2 (disparity-search) axis of
+    # the correlation volume across a mesh axis for full-res inputs.
+    corr_w2_shards: int = 1
+
+    def __post_init__(self):
+        if self.context_dims is None:
+            object.__setattr__(self, "context_dims", tuple(self.hidden_dims))
+        object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
+        object.__setattr__(self, "context_dims", tuple(self.context_dims))
+        if self.corr_backend not in CORR_BACKENDS:
+            alias = _REFERENCE_CORR_ALIASES.get(self.corr_backend)
+            if alias is None:
+                raise ValueError(
+                    f"corr_backend={self.corr_backend!r} not in {CORR_BACKENDS}")
+            object.__setattr__(self, "corr_backend", alias)
+        if not (1 <= self.n_gru_layers <= min(len(self.hidden_dims), 3)):
+            raise ValueError(
+                "n_gru_layers must be in [1, min(len(hidden_dims), 3)] — the "
+                "update block implements at most 3 GRU levels")
+
+    # ------------------------------------------------------------------ sizes
+    @property
+    def downsample_factor(self) -> int:
+        return 2 ** self.n_downsample
+
+    @property
+    def corr_channels(self) -> int:
+        """Channels of one correlation lookup (reference: core/update.py:69)."""
+        return self.corr_levels * (2 * self.corr_radius + 1)
+
+    @property
+    def mask_channels(self) -> int:
+        """Convex-upsample mask channels (reference: core/update.py:108-113)."""
+        return 9 * self.downsample_factor ** 2
+
+    # -------------------------------------------------------------- serialize
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RaftStereoConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "RaftStereoConfig":
+        return cls.from_dict(json.loads(s))
+
+    # ---------------------------------------------------------------- presets
+    @classmethod
+    def default(cls) -> "RaftStereoConfig":
+        """The published middlebury/eth3d/sceneflow architecture."""
+        return cls()
+
+    @classmethod
+    def realtime(cls) -> "RaftStereoConfig":
+        """The realtime config (reference: README.md:84)."""
+        return cls(shared_backbone=True, n_downsample=3, n_gru_layers=2,
+                   slow_fast_gru=True, corr_backend="reg_fused",
+                   mixed_precision=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training hyper-parameters (reference: train_stereo.py:221-247)."""
+
+    batch_size: int = 8
+    train_iters: int = 22          # GRU iterations during training
+    valid_iters: int = 32          # GRU iterations at validation
+    lr: float = 2e-4
+    num_steps: int = 200_000
+    wdecay: float = 1e-5
+    epsilon: float = 1e-8
+    clip_grad_norm: float = 1.0
+    image_size: Tuple[int, int] = (320, 720)
+    train_datasets: Tuple[str, ...] = ("sceneflow",)
+    # Sequence-loss schedule (reference: train_stereo.py:52-54)
+    loss_gamma: float = 0.9
+    max_flow: float = 700.0
+    # Augmentation (reference: train_stereo.py:243-247)
+    img_gamma: Optional[Tuple[float, float]] = None
+    saturation_range: Optional[Tuple[float, float]] = None
+    do_flip: Optional[str] = None  # None | "h" | "v"
+    spatial_scale: Tuple[float, float] = (-0.2, 0.4)
+    noyjitter: bool = False
+    # Runtime
+    validation_frequency: int = 10_000
+    seed: int = 1234
+    # Parallelism: devices along the data axis; 0 = all available.
+    data_parallel: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = dict(d)
+        for k in ("image_size", "train_datasets", "img_gamma",
+                  "saturation_range", "spatial_scale"):
+            if k in d and isinstance(d[k], list):
+                d[k] = tuple(d[k])
+        return cls(**{k: v for k, v in d.items() if k in known})

@@ -1,0 +1,24 @@
+"""Pallas TPU kernel: fused correlation-pyramid window lookup.
+
+TPU-native replacement for the reference's CUDA extension
+(reference: sampler/sampler_kernel.cu — one thread per output pixel streaming
+2r+2 taps along the disparity axis; hand-written scatter backward).
+
+Placeholder in this milestone: the XLA lookup in models/corr.py is the live
+path; the fused kernel lands with the performance phase (SURVEY.md §7 step 9).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+
+def fused_lookup_available() -> bool:
+    return False
+
+
+def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
+                         radius: int) -> jnp.ndarray:
+    raise NotImplementedError("Pallas fused lookup lands in the perf phase")

@@ -1,0 +1,3 @@
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+__all__ = ["RAFTStereo"]

@@ -1,0 +1,163 @@
+"""1-D (epipolar) all-pairs correlation backends.
+
+The reference's performance-critical switch (reference: core/corr.py, dispatch
+at core/raft_stereo.py:90-100) — all backends implement one contract:
+
+    corr_fn = make_corr_fn(config, fmap1, fmap2)   # NHWC feature maps
+    feats   = corr_fn(coords_x)                    # (B,H,W1) x-positions
+    # feats: (B, H, W1, corr_levels * (2*radius+1)), level-major channels
+
+Backends:
+* ``reg``       — precompute the all-pairs (B,H,W1,W2) volume as a batched
+                  matmul (MXU), average-pool a W2 pyramid, and look windows up
+                  with the XLA 1-D linear sampler.  Correctness reference.
+                  (≙ reference CorrBlock1D, core/corr.py:110-156.)
+* ``alt``       — no precomputed volume: per lookup, linearly sample the
+                  (progressively W-pooled) right feature map and dot with the
+                  left features.  O(H·W·(2r+1)·D) per iteration instead of
+                  O(H·W²) memory — the full-resolution / "long-context" path.
+                  (≙ reference PytorchAlternateCorrBlock1D, core/corr.py:64-107.)
+* ``reg_fused`` — same math as ``reg`` with the pyramid lookup fused into a
+                  Pallas TPU kernel (≙ reference CorrBlockFast1D + the CUDA
+                  sampler/ extension), bf16-safe.
+
+The volume build runs in fp32 for ``reg``/``alt`` mirroring the reference's
+autocast boundary (core/raft_stereo.py:92,95); ``reg_fused`` keeps the input
+dtype (the point of the reference's fp16 CUDA kernel —
+sampler/sampler_kernel.cu:126).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.ops.sampler import (linear_sampler_1d,
+                                         linear_sampler_1d_features)
+
+CorrFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def build_corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                      precision=lax.Precision.HIGHEST) -> jnp.ndarray:
+    """(B,H,W1,D), (B,H,W2,D) → (B,H,W1,W2) dot-product volume / sqrt(D).
+
+    A batched (W1, D) × (D, W2) matmul per image row — the MXU-friendly
+    formulation of the reference's einsum (core/corr.py:154).
+    """
+    d = fmap1.shape[-1]
+    corr = jnp.einsum("bhwd,bhvd->bhwv", fmap1, fmap2, precision=precision)
+    return corr / math.sqrt(d)
+
+
+def pool_last_axis(x: jnp.ndarray) -> jnp.ndarray:
+    """(… , W) → (…, W//2): 2-wide stride-2 mean along the last axis
+    (reference: core/corr.py:124 ``F.avg_pool2d([1,2])``, floor semantics)."""
+    w2 = (x.shape[-1] // 2) * 2
+    x = x[..., :w2]
+    return 0.5 * (x[..., 0::2] + x[..., 1::2])
+
+
+def build_corr_pyramid(corr: jnp.ndarray, num_levels: int) -> List[jnp.ndarray]:
+    """Level i has W2 // 2^i disparity bins.  The reference stores
+    ``num_levels+1`` entries but only ever reads ``num_levels``
+    (core/corr.py:122-125 vs :133) — we build exactly ``num_levels``."""
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        pyramid.append(pool_last_axis(pyramid[-1]))
+    return pyramid
+
+
+def _window_coords(coords: jnp.ndarray, level: int, radius: int) -> jnp.ndarray:
+    """(B,H,W1) center x-positions → (B,H,W1,2r+1) tap positions at ``level``."""
+    dx = jnp.arange(-radius, radius + 1, dtype=coords.dtype)
+    return coords[..., None] / (2 ** level) + dx
+
+
+def lookup_pyramid_xla(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
+                       radius: int) -> jnp.ndarray:
+    """Bilinear window lookup at every level; concat level-major
+    (reference: core/corr.py:127-146)."""
+    outs = [linear_sampler_1d(vol, _window_coords(coords, i, radius))
+            for i, vol in enumerate(pyramid)]
+    return jnp.concatenate(outs, axis=-1)
+
+
+# --------------------------------------------------------------------- reg
+def make_corr_fn_reg(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
+    fmap1 = fmap1.astype(jnp.float32)
+    fmap2 = fmap2.astype(jnp.float32)
+    pyramid = build_corr_pyramid(build_corr_volume(fmap1, fmap2),
+                                 cfg.corr_levels)
+
+    def corr_fn(coords):
+        return lookup_pyramid_xla(pyramid, coords, cfg.corr_radius)
+
+    return corr_fn
+
+
+# --------------------------------------------------------------------- alt
+def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
+    fmap1 = fmap1.astype(jnp.float32)
+    fmap2 = fmap2.astype(jnp.float32)
+    d = fmap1.shape[-1]
+    # Progressively W-pooled right features (reference: core/corr.py:104).
+    fmap2_pyramid = [fmap2]
+    for _ in range(cfg.corr_levels - 1):
+        f = fmap2_pyramid[-1]
+        w2 = (f.shape[2] // 2) * 2
+        fmap2_pyramid.append(0.5 * (f[:, :, 0:w2:2] + f[:, :, 1:w2:2]))
+
+    def corr_fn(coords):
+        outs = []
+        for i, f2 in enumerate(fmap2_pyramid):
+            taps = _window_coords(coords, i, cfg.corr_radius)  # (B,H,W1,K)
+            sampled = linear_sampler_1d_features(f2, taps)     # (B,H,W1,K,D)
+            outs.append(jnp.einsum("bhwd,bhwkd->bhwk", fmap1, sampled,
+                                   precision=lax.Precision.HIGHEST)
+                        / math.sqrt(d))
+        return jnp.concatenate(outs, axis=-1)
+
+    return corr_fn
+
+
+# --------------------------------------------------------------- reg_fused
+def make_corr_fn_reg_fused(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
+    """Pallas-fused pyramid lookup (≙ reference sampler/ CUDA extension).
+
+    Falls back to the XLA lookup when Pallas is unavailable (e.g. CPU tests).
+    Keeps the compute dtype of the inputs (bf16-safe)."""
+    from raft_stereo_tpu.kernels.corr_lookup import (
+        fused_lookup_available, lookup_pyramid_fused)
+
+    compute_dtype = fmap1.dtype
+    pyramid = build_corr_pyramid(
+        build_corr_volume(fmap1.astype(jnp.float32),
+                          fmap2.astype(jnp.float32)).astype(compute_dtype),
+        cfg.corr_levels)
+
+    if fused_lookup_available():
+        def corr_fn(coords):
+            return lookup_pyramid_fused(pyramid, coords, cfg.corr_radius)
+    else:
+        def corr_fn(coords):
+            return lookup_pyramid_xla(pyramid, coords, cfg.corr_radius)
+
+    return corr_fn
+
+
+_BACKENDS = {
+    "reg": make_corr_fn_reg,
+    "alt": make_corr_fn_alt,
+    "reg_fused": make_corr_fn_reg_fused,
+}
+
+
+def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
+                 fmap2: jnp.ndarray) -> CorrFn:
+    """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100)."""
+    return _BACKENDS[cfg.corr_backend](cfg, fmap1, fmap2)

@@ -1,0 +1,164 @@
+"""Feature / context encoders (reference: core/extractor.py).
+
+TPU-first re-design notes:
+* NHWC layout throughout (TPU-native), params fp32 with a configurable compute
+  dtype (bf16 under mixed precision — replaces torch autocast).
+* Explicit symmetric padding tuples so strided convs match torch's
+  ``padding=k//2`` exactly (XLA ``SAME`` splits padding asymmetrically for
+  even inputs).
+* Kaiming-normal(fan_out) conv init mirroring core/extractor.py:155-162;
+  biases init to zero.
+* The reference's list-input batching trick (core/extractor.py:176-179) is the
+  caller's job here: concatenate the two images along batch before calling.
+* ``BottleneckBlock`` (core/extractor.py:64-120) is dead code in the reference
+  and intentionally not rebuilt (SURVEY.md §2 "dead code").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_stereo_tpu.models.norm import apply_norm, make_norm
+
+# torch kaiming_normal_(mode='fan_out', nonlinearity='relu')
+kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def conv(features, kernel, stride=1, *, dtype, name):
+    k = (kernel, kernel) if isinstance(kernel, int) else kernel
+    pad = tuple((s // 2, s // 2) for s in k)
+    return nn.Conv(features, k, strides=(stride, stride), padding=pad,
+                   dtype=dtype, kernel_init=kaiming_out,
+                   bias_init=nn.initializers.zeros, name=name)
+
+
+class ResidualBlock(nn.Module):
+    """Two 3×3 convs + norm + skip (reference: core/extractor.py:6-60)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        y = conv(self.planes, 3, self.stride, dtype=self.dtype, name="conv1")(x)
+        y = apply_norm(make_norm(self.norm_fn, self.planes, self.dtype, "norm1"), y)
+        y = nn.relu(y)
+        y = conv(self.planes, 3, 1, dtype=self.dtype, name="conv2")(y)
+        y = apply_norm(make_norm(self.norm_fn, self.planes, self.dtype, "norm2"), y)
+        y = nn.relu(y)
+
+        if not (self.stride == 1 and in_planes == self.planes):
+            x = conv(self.planes, 1, self.stride, dtype=self.dtype,
+                     name="downsample_conv")(x)
+            x = apply_norm(
+                make_norm(self.norm_fn, self.planes, self.dtype, "norm3"), x)
+        return nn.relu(x + y)
+
+
+class _Trunk(nn.Module):
+    """Shared stem + 3 residual stages (64 → 96 → 128) at 1/2^downsample res."""
+
+    norm_fn: str
+    downsample: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = conv(64, 7, 1 + (self.downsample > 2), dtype=self.dtype,
+                 name="conv1")(x)
+        x = apply_norm(make_norm(self.norm_fn, 64, self.dtype, "norm1"), x)
+        x = nn.relu(x)
+        for i, (dim, stride) in enumerate(
+                [(64, 1),
+                 (96, 1 + (self.downsample > 1)),
+                 (128, 1 + (self.downsample > 0))], start=1):
+            x = ResidualBlock(dim, self.norm_fn, stride, dtype=self.dtype,
+                              name=f"layer{i}_0")(x)
+            x = ResidualBlock(dim, self.norm_fn, 1, dtype=self.dtype,
+                              name=f"layer{i}_1")(x)
+        return x
+
+
+class BasicEncoder(nn.Module):
+    """fnet: trunk + 1×1 projection (reference: core/extractor.py:122-197)."""
+
+    output_dim: int = 128
+    norm_fn: str = "instance"
+    downsample: int = 3
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = _Trunk(self.norm_fn, self.downsample, self.dtype, name="trunk")(x)
+        return conv(self.output_dim, 1, 1, dtype=self.dtype, name="conv2")(x)
+
+
+class MultiBasicEncoder(nn.Module):
+    """cnet: trunk + two extra stride-2 stages + per-resolution output heads
+    (reference: core/extractor.py:199-300).
+
+    ``output_dims`` is a sequence of per-head channel tuples, each ordered
+    FINE → COARSE (our convention; the reference indexes ``dim[2]`` for the
+    finest head — core/extractor.py:231).  Head h at level l emits
+    ``output_dims[h][l]`` channels.
+
+    Returns ``(levels, v)`` where ``levels[l]`` is a list over heads of
+    features at 1/2^(downsample+l) resolution (only ``num_layers`` levels),
+    and ``v`` is the full-batch trunk output (for ``shared_backbone``;
+    reference's ``dual_inp`` — core/extractor.py:283-285).
+    """
+
+    output_dims: Sequence[Tuple[int, ...]] = ((128, 128, 128),)
+    norm_fn: str = "batch"
+    downsample: int = 3
+    num_layers: int = 3
+    dual_inp: bool = False
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = _Trunk(self.norm_fn, self.downsample, self.dtype, name="trunk")(x)
+        v = x
+        if self.dual_inp:
+            x = x[: x.shape[0] // 2]
+
+        levels = []
+        # level 0 (finest, 1/2^downsample): ResidualBlock + 3×3 conv heads
+        outs = []
+        for h, dims in enumerate(self.output_dims):
+            y = ResidualBlock(128, self.norm_fn, 1, dtype=self.dtype,
+                              name=f"outputs08_{h}_res")(x)
+            outs.append(conv(dims[0], 3, 1, dtype=self.dtype,
+                             name=f"outputs08_{h}_conv")(y))
+        levels.append(outs)
+
+        if self.num_layers >= 2:
+            x16 = ResidualBlock(128, self.norm_fn, 2, dtype=self.dtype,
+                                name="layer4_0")(x)
+            x16 = ResidualBlock(128, self.norm_fn, 1, dtype=self.dtype,
+                                name="layer4_1")(x16)
+            outs = []
+            for h, dims in enumerate(self.output_dims):
+                y = ResidualBlock(128, self.norm_fn, 1, dtype=self.dtype,
+                                  name=f"outputs16_{h}_res")(x16)
+                outs.append(conv(dims[1], 3, 1, dtype=self.dtype,
+                                 name=f"outputs16_{h}_conv")(y))
+            levels.append(outs)
+
+        if self.num_layers >= 3:
+            x32 = ResidualBlock(128, self.norm_fn, 2, dtype=self.dtype,
+                                name="layer5_0")(x16)
+            x32 = ResidualBlock(128, self.norm_fn, 1, dtype=self.dtype,
+                                name="layer5_1")(x32)
+            outs = [conv(dims[2], 3, 1, dtype=self.dtype,
+                         name=f"outputs32_{h}_conv")(x32)
+                    for h, dims in enumerate(self.output_dims)]
+            levels.append(outs)
+
+        return levels, v

@@ -1,0 +1,180 @@
+"""RAFT-Stereo top-level model (reference: core/raft_stereo.py).
+
+TPU-first re-design:
+* The GRU refinement loop is a ``jax.lax.scan`` — one compiled, weight-tied
+  step instead of the reference's Python loop (core/raft_stereo.py:108-136).
+  Per-iteration upsampled predictions fall out as scan ys for the sequence
+  loss; in test mode the scan carries only state and upsampling happens once.
+* Disparity state is a single x-channel field (the reference carries a full
+  2-channel coordinate grid and zeroes the y update every iteration —
+  core/raft_stereo.py:120).  A zero y-channel is materialized only for the
+  motion encoder's 2-channel flow input (checkpoint compatibility).
+* Mixed precision = bf16 compute dtype on encoders + update block, with the
+  correlation volume in fp32 for reg/alt, mirroring the reference's autocast
+  boundaries (core/raft_stereo.py:77,90-99,112).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.corr import make_corr_fn
+from raft_stereo_tpu.models.extractor import (BasicEncoder, MultiBasicEncoder,
+                                              ResidualBlock, conv)
+from raft_stereo_tpu.models.update import BasicMultiUpdateBlock
+from raft_stereo_tpu.ops.grids import coords_grid_x
+from raft_stereo_tpu.ops.upsample import convex_upsample
+
+
+class RAFTStereo(nn.Module):
+    config: RaftStereoConfig
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.config.mixed_precision else jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        dtype = self.compute_dtype
+        self.cnet = MultiBasicEncoder(
+            output_dims=(cfg.hidden_dims, cfg.context_dims),
+            norm_fn=cfg.context_norm, downsample=cfg.n_downsample,
+            num_layers=cfg.n_gru_layers, dual_inp=cfg.shared_backbone,
+            dtype=dtype, name="cnet")
+        self.update_block = BasicMultiUpdateBlock(cfg, dtype=dtype,
+                                                  name="update_block")
+        # Per-level 3×3 convs producing the GRU context biases once per forward
+        # (reference: core/raft_stereo.py:32,87-88).
+        self.context_zqr_convs = [
+            conv(cfg.hidden_dims[l] * 3, 3, 1, dtype=dtype,
+                 name=f"context_zqr_conv{l}")
+            for l in range(cfg.n_gru_layers)]
+        if cfg.shared_backbone:
+            self.conv2_res = ResidualBlock(128, "instance", 1, dtype=dtype,
+                                           name="conv2_res")
+            self.conv2_out = conv(cfg.fnet_dim, 3, 1, dtype=dtype,
+                                  name="conv2_out")
+        else:
+            self.fnet = BasicEncoder(output_dim=cfg.fnet_dim,
+                                     norm_fn=cfg.fnet_norm,
+                                     downsample=cfg.n_downsample,
+                                     dtype=dtype, name="fnet")
+
+    def __call__(self, image1: jnp.ndarray, image2: jnp.ndarray,
+                 iters: int = 12, flow_init: Optional[jnp.ndarray] = None,
+                 test_mode: bool = False):
+        """Estimate disparity for a rectified stereo pair.
+
+        Args:
+          image1, image2: (B, H, W, 3) uint8-range images (0..255), NHWC.
+          iters: number of GRU refinement iterations (static).
+          flow_init: optional (B, H/f, W/f) initial x-flow.
+          test_mode: if True return ``(flow_low, flow_up)`` like the reference
+            (core/raft_stereo.py:138-139); else the per-iteration list of
+            full-resolution x-flow predictions, shape (iters, B, H, W).
+        """
+        cfg = self.config
+        dtype = self.compute_dtype
+        image1 = (2 * (image1 / 255.0) - 1.0).astype(dtype)
+        image2 = (2 * (image2 / 255.0) - 1.0).astype(dtype)
+
+        if cfg.shared_backbone:
+            levels, v = self.cnet(jnp.concatenate([image1, image2], axis=0))
+            fmap = self.conv2_out(self.conv2_res(v))
+            fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
+        else:
+            levels, _ = self.cnet(image1)
+            both = self.fnet(jnp.concatenate([image1, image2], axis=0))
+            fmap1, fmap2 = jnp.split(both, 2, axis=0)
+
+        # levels[l] = [hidden_head, context_head] at level l (fine→coarse)
+        net_list = [jnp.tanh(lv[0]) for lv in levels]
+        # Precompute GRU context biases cz, cr, cq once
+        # (reference: core/raft_stereo.py:87-88).
+        context = []
+        for l, lv in enumerate(levels):
+            biases = self.context_zqr_convs[l](nn.relu(lv[1]))
+            context.append(tuple(jnp.split(biases, 3, axis=-1)))
+
+        corr_fn = make_corr_fn(cfg, fmap1, fmap2)
+
+        b, h8, w8, _ = net_list[0].shape
+        grid_x = coords_grid_x(b, h8, w8, dtype=jnp.float32)
+        disp = jnp.zeros((b, h8, w8), jnp.float32)
+        if flow_init is not None:
+            disp = disp + flow_init
+
+        n = cfg.n_gru_layers
+
+        def gru_step(module, net_list, disp):
+            """One refinement iteration (reference: core/raft_stereo.py:108-123)."""
+            disp = jax.lax.stop_gradient(disp)
+            corr = corr_fn(grid_x + disp).astype(dtype)
+            flow2 = jnp.stack([disp, jnp.zeros_like(disp)],
+                              axis=-1).astype(dtype)
+
+            net_list = list(net_list)
+            if n == 3 and cfg.slow_fast_gru:
+                net_list = module.update_block(net_list, context,
+                                               iter_fine=False, iter_mid=False,
+                                               update=False)
+            if n >= 2 and cfg.slow_fast_gru:
+                net_list = module.update_block(net_list, context,
+                                               iter_fine=False,
+                                               iter_coarse=(n == 3),
+                                               update=False)
+            net_list, up_mask, delta_flow = module.update_block(
+                net_list, context, corr, flow2,
+                iter_mid=(n >= 2), iter_coarse=(n == 3))
+
+            # Epipolar projection: only the x component updates
+            # (reference: core/raft_stereo.py:120).
+            disp = disp + delta_flow[..., 0].astype(jnp.float32)
+            return net_list, disp, up_mask
+
+        if test_mode:
+            # No per-iteration outputs needed; the scan carries state (plus
+            # the latest mask) and upsampling happens once at the end
+            # (reference skips intermediate upsampling in test mode —
+            # core/raft_stereo.py:126-127).
+            def body_test(module, carry, _):
+                net_list, disp, _mask = carry
+                net_list, disp, up_mask = gru_step(module, net_list, disp)
+                return (tuple(net_list), disp, up_mask), None
+
+            scan_test = nn.scan(body_test, variable_broadcast=("params", "batch_stats"),
+                                split_rngs={"params": False}, length=iters)
+            mask0 = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+            (net_fin, disp_fin, mask_fin), _ = scan_test(
+                self, (tuple(net_list), disp, mask0), None)
+            flow_up = self._upsample(disp_fin, mask_fin)
+            return disp_fin, flow_up
+
+        def body_train(module, carry, _):
+            net_list, disp = carry
+            net_list, disp, up_mask = gru_step(module, net_list, disp)
+            # Upsample inside the scan so per-iteration masks never
+            # accumulate in HBM.
+            flow_up = module._upsample(disp, up_mask)
+            return (tuple(net_list), disp), flow_up
+
+        scan_train = nn.scan(body_train, variable_broadcast=("params", "batch_stats"),
+                             split_rngs={"params": False}, length=iters)
+        (net_fin, disp_fin), flow_ups = scan_train(
+            self, (tuple(net_list), disp), None)
+        return flow_ups  # (iters, B, H, W)
+
+    def _upsample(self, disp: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        """Convex-upsample a (B,h,w) disparity to full resolution (B,H,W)."""
+        up = convex_upsample(disp[..., None], mask.astype(jnp.float32),
+                             self.config.downsample_factor)
+        return up[..., 0]
+
+
+def create_model(cfg: RaftStereoConfig):
+    return RAFTStereo(cfg)

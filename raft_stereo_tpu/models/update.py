@@ -1,0 +1,128 @@
+"""Recurrent update block (reference: core/update.py).
+
+Level indexing convention: level 0 is the FINEST resolution
+(1/2^n_downsample); the reference's gru08/gru16/gru32 are our levels 0/1/2.
+The context-bias triples (cz, cr, cq) are precomputed once per forward by the
+model (reference: core/raft_stereo.py:87-88) and passed in per level.
+
+``SepConvGRU`` (core/update.py:34-62) is dead code in the reference and not
+rebuilt (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.extractor import conv
+from raft_stereo_tpu.ops.pooling import pool2x
+from raft_stereo_tpu.ops.resize import interp_like
+
+
+class FlowHead(nn.Module):
+    """2-conv disparity-delta head (reference: core/update.py:6-14)."""
+
+    hidden_dim: int = 256
+    output_dim: int = 2
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(conv(self.hidden_dim, 3, 1, dtype=self.dtype, name="conv1")(x))
+        return conv(self.output_dim, 3, 1, dtype=self.dtype, name="conv2")(y)
+
+
+class ConvGRU(nn.Module):
+    """ConvGRU with pre-computed context biases (reference: core/update.py:16-32)."""
+
+    hidden_dim: int
+    kernel_size: int = 3
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, h, context, *x_list):
+        cz, cr, cq = context
+        x = jnp.concatenate(x_list, axis=-1)
+        hx = jnp.concatenate([h, x], axis=-1)
+        k = self.kernel_size
+        z = nn.sigmoid(conv(self.hidden_dim, k, 1, dtype=self.dtype,
+                            name="convz")(hx) + cz)
+        r = nn.sigmoid(conv(self.hidden_dim, k, 1, dtype=self.dtype,
+                            name="convr")(hx) + cr)
+        q = nn.tanh(conv(self.hidden_dim, k, 1, dtype=self.dtype, name="convq")(
+            jnp.concatenate([r * h, x], axis=-1)) + cq)
+        return (1 - z) * h + z * q
+
+
+class BasicMotionEncoder(nn.Module):
+    """Encode correlation + flow into 128-ch motion features
+    (reference: core/update.py:64-85)."""
+
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(conv(64, 1, 1, dtype=self.dtype, name="convc1")(corr))
+        cor = nn.relu(conv(64, 3, 1, dtype=self.dtype, name="convc2")(cor))
+        flo = nn.relu(conv(64, 7, 1, dtype=self.dtype, name="convf1")(flow))
+        flo = nn.relu(conv(64, 3, 1, dtype=self.dtype, name="convf2")(flo))
+        out = nn.relu(conv(128 - 2, 3, 1, dtype=self.dtype, name="conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class BasicMultiUpdateBlock(nn.Module):
+    """Up to 3 cross-coupled ConvGRUs + flow/mask heads
+    (reference: core/update.py:97-138)."""
+
+    config: RaftStereoConfig
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, net: Sequence[jnp.ndarray],
+                 context: Sequence[Tuple[jnp.ndarray, ...]],
+                 corr: Optional[jnp.ndarray] = None,
+                 flow: Optional[jnp.ndarray] = None,
+                 iter_fine: bool = True, iter_mid: bool = True,
+                 iter_coarse: bool = True, update: bool = True):
+        cfg = self.config
+        hd = cfg.hidden_dims  # fine → coarse
+        n = cfg.n_gru_layers
+        net = list(net)
+
+        # GRU input dims mirror reference core/update.py:104-106 under our
+        # fine→coarse indexing.
+        if iter_coarse and n == 3:
+            net[2] = ConvGRU(hd[2], dtype=self.dtype, name="gru32")(
+                net[2], context[2], pool2x(net[1]))
+        if iter_mid and n >= 2:
+            if n > 2:
+                net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
+                    net[1], context[1], pool2x(net[0]),
+                    interp_like(net[2], net[1]))
+            else:
+                net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
+                    net[1], context[1], pool2x(net[0]))
+        if iter_fine:
+            motion = BasicMotionEncoder(dtype=self.dtype, name="encoder")(
+                flow, corr)
+            if n > 1:
+                net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
+                    net[0], context[0], motion, interp_like(net[1], net[0]))
+            else:
+                net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
+                    net[0], context[0], motion)
+
+        if not update:
+            return net
+
+        delta_flow = FlowHead(256, 2, dtype=self.dtype, name="flow_head")(net[0])
+
+        # mask scaled ×0.25 "to balance gradients" (core/update.py:136-137)
+        m = nn.relu(conv(256, 3, 1, dtype=self.dtype, name="mask_conv1")(net[0]))
+        mask = 0.25 * conv(cfg.mask_channels, 1, 1, dtype=self.dtype,
+                           name="mask_conv2")(m)
+        return net, mask, delta_flow

@@ -1,0 +1,12 @@
+from raft_stereo_tpu.ops.grids import coords_grid_x
+from raft_stereo_tpu.ops.sampler import linear_sampler_1d, linear_sampler_1d_features
+from raft_stereo_tpu.ops.resize import resize_bilinear_align_corners, interp_like, upsample_flow_bilinear
+from raft_stereo_tpu.ops.pooling import avg_pool2d, pool2x
+from raft_stereo_tpu.ops.upsample import convex_upsample
+from raft_stereo_tpu.ops.padding import InputPadder
+
+__all__ = [
+    "coords_grid_x", "linear_sampler_1d", "linear_sampler_1d_features",
+    "resize_bilinear_align_corners", "interp_like", "upsample_flow_bilinear",
+    "avg_pool2d", "pool2x", "convex_upsample", "InputPadder",
+]

@@ -1,0 +1,18 @@
+"""Coordinate grids.
+
+The reference carries a full 2-channel (x, y) coordinate grid and then zeroes
+the y component of every update (reference: core/raft_stereo.py:46-53,120,
+core/utils/utils.py:76-79).  Stereo disparity is 1-D, so we carry only the x
+channel; the y channel is materialized as zeros exactly where a 2-channel
+tensor is needed for checkpoint compatibility (motion encoder input).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def coords_grid_x(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jnp.ndarray:
+    """x-coordinate grid of shape (batch, ht, wd)."""
+    x = jnp.arange(wd, dtype=dtype)
+    return jnp.broadcast_to(x[None, None, :], (batch, ht, wd))

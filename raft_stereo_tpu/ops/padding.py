@@ -1,0 +1,45 @@
+"""Pad inputs to a divisibility constraint and exactly un-pad outputs.
+
+Reference: core/utils/utils.py:7-26 (``InputPadder``) — replicate-mode padding,
+'sintel' (symmetric) vs default (bottom/right-biased) layouts, eval uses
+``divis_by=32`` (evaluate_stereo.py:31).  NHWC here.
+
+Note the reference's formula pads to the NEXT multiple when already divisible
+is false; ``(((d // k) + 1) * k - d) % k`` is 0 when d is divisible by k.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class InputPadder:
+    def __init__(self, dims, mode: str = "sintel", divis_by: int = 8):
+        self.ht, self.wd = int(dims[-3]), int(dims[-2])  # NHWC
+        pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
+        pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
+        if mode == "sintel":
+            # (left, right, top, bottom)
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2]
+        else:
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    def pad(self, *inputs):
+        out = []
+        for x in inputs:
+            assert x.ndim == 4, "expected NHWC"
+            out.append(jnp.pad(
+                x,
+                ((0, 0), (self._pad[2], self._pad[3]),
+                 (self._pad[0], self._pad[1]), (0, 0)),
+                mode="edge"))
+        return out
+
+    def unpad(self, x):
+        """Exactly undo ``pad``.  Accepts NHWC (B,H,W,C) or the model's 3-D
+        disparity outputs (B,H,W)."""
+        assert x.ndim in (3, 4), "expected (B,H,W[,C])"
+        ht, wd = x.shape[1], x.shape[2]
+        return x[:, self._pad[2]:ht - self._pad[3],
+                 self._pad[0]:wd - self._pad[1]]

@@ -1,0 +1,36 @@
+"""Average pooling with the reference's exact divisor semantics.
+
+``F.avg_pool2d`` defaults to ``count_include_pad=True`` — the divisor is always
+the full window size even at padded borders (reference: core/update.py:87-91
+``pool2x``/``pool4x``; core/corr.py:124 pyramid pooling, unpadded).  We use
+``lax.reduce_window`` sums divided by the static window size.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+
+def avg_pool2d(x: jnp.ndarray, window, strides, padding) -> jnp.ndarray:
+    """NHWC average pool; ``padding`` is ((top,bottom),(left,right)).
+
+    Divisor is the full window size (torch ``count_include_pad=True``).
+    """
+    wh, ww = window
+    sums = lax.reduce_window(
+        x, np.array(0, x.dtype), lax.add,
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, strides[0], strides[1], 1),
+        padding=((0, 0), tuple(padding[0]), tuple(padding[1]), (0, 0)),
+    )
+    return sums / jnp.array(wh * ww, x.dtype)
+
+
+def pool2x(x: jnp.ndarray) -> jnp.ndarray:
+    """3×3 stride-2 pad-1 average pool (reference: core/update.py:87-88).
+
+    The reference also defines ``pool4x`` (core/update.py:90-91) but never
+    calls it — dead code, not rebuilt (SURVEY.md §2 policy)."""
+    return avg_pool2d(x, (3, 3), (2, 2), ((1, 1), (1, 1)))

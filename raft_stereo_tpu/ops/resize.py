@@ -1,0 +1,62 @@
+"""Bilinear resize with ``align_corners=True`` semantics.
+
+The reference uses ``F.interpolate(..., mode='bilinear', align_corners=True)``
+for cross-resolution GRU coupling (reference: core/update.py:93-95) and the
+no-mask flow upsampling fallback (core/utils/utils.py:82-84).  ``jax.image``
+has no align_corners mode, so we build the (dense, tiny) interpolation weight
+matrices and apply them as two matmuls — which also happens to be the
+MXU-friendly formulation on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=128)
+def _interp_matrix(src: int, dst: int) -> np.ndarray:
+    """(dst, src) align-corners bilinear interpolation matrix (float32)."""
+    m = np.zeros((dst, src), dtype=np.float32)
+    if dst == 1:
+        m[0, 0] = 1.0
+        return m
+    scale = (src - 1) / (dst - 1)
+    pos = np.arange(dst) * scale
+    lo = np.clip(np.floor(pos).astype(np.int64), 0, src - 1)
+    hi = np.clip(lo + 1, 0, src - 1)
+    frac = (pos - lo).astype(np.float32)
+    m[np.arange(dst), lo] += 1.0 - frac
+    m[np.arange(dst), hi] += frac
+    return m
+
+
+def resize_bilinear_align_corners(x: jnp.ndarray, out_hw) -> jnp.ndarray:
+    """Resize NHWC ``x`` to spatial size ``out_hw`` (align-corners bilinear)."""
+    h, w = x.shape[1], x.shape[2]
+    oh, ow = int(out_hw[0]), int(out_hw[1])
+    if (h, w) == (oh, ow):
+        return x
+    dtype = x.dtype
+    if h != oh:
+        my = jnp.asarray(_interp_matrix(h, oh), dtype=dtype)
+        x = jnp.einsum("bhwc,oh->bowc", x, my, precision=lax.Precision.HIGHEST)
+    if w != ow:
+        mx = jnp.asarray(_interp_matrix(w, ow), dtype=dtype)
+        x = jnp.einsum("bhwc,ow->bhoc", x, mx, precision=lax.Precision.HIGHEST)
+    return x
+
+
+def interp_like(x: jnp.ndarray, dest: jnp.ndarray) -> jnp.ndarray:
+    """Resize ``x`` to ``dest``'s spatial size (reference: core/update.py:93-95)."""
+    return resize_bilinear_align_corners(x, dest.shape[1:3])
+
+
+def upsample_flow_bilinear(flow: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """×factor bilinear flow upsample, scaling values by ``factor``
+    (reference: core/utils/utils.py:82-84)."""
+    h, w = flow.shape[1], flow.shape[2]
+    return factor * resize_bilinear_align_corners(flow, (factor * h, factor * w))

@@ -1,0 +1,80 @@
+"""1-D linear sampling along the disparity (W2) axis.
+
+TPU-native replacement for the reference's ``grid_sample``-based
+``bilinear_sampler`` (reference: core/utils/utils.py:59-73), specialized to the
+stereo case the reference asserts anyway (H == 1 rows): sampling is linear
+interpolation along the last axis with zero padding outside ``[0, W-1]`` and
+``align_corners=True`` pixel-coordinate semantics.
+
+Implemented as two clipped ``take_along_axis`` gathers + a lerp.  A fused
+Pallas kernel (kernels/corr_lookup.py) provides the high-performance path; this
+XLA version is the correctness reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def linear_sampler_1d(vol: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Linearly sample ``vol`` along its last axis at positions ``x``.
+
+    Args:
+      vol: (..., W) values.
+      x:   (..., K) sample positions in pixel coordinates; leading dims must
+           broadcast against ``vol``'s leading dims.
+
+    Returns:
+      (..., K) sampled values, zero for taps outside ``[0, W-1]``.
+    """
+    w = vol.shape[-1]
+    x0 = jnp.floor(x)
+    frac = (x - x0).astype(vol.dtype)
+    x0i = x0.astype(jnp.int32)
+    x1i = x0i + 1
+
+    def tap(idx):
+        valid = (idx >= 0) & (idx <= w - 1)
+        safe = jnp.clip(idx, 0, w - 1)
+        v = jnp.take_along_axis(
+            jnp.broadcast_to(vol, x.shape[:-1] + (w,)), safe, axis=-1)
+        return jnp.where(valid, v, jnp.zeros_like(v))
+
+    return tap(x0i) * (1.0 - frac) + tap(x1i) * frac
+
+
+def linear_sampler_1d_features(fmap: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Vector-valued variant of :func:`linear_sampler_1d`: sample a feature
+    map along its W axis.
+
+    Same boundary semantics (zero padding outside ``[0, W-1]``,
+    ``align_corners=True`` pixel coordinates) — keep the two in sync; the
+    cross-backend tests in tests/test_corr.py assert they agree.
+
+    Implemented with a direct ``take_along_axis`` on the W axis (rather than
+    delegating to :func:`linear_sampler_1d`) so the (B,H,W1,K,D) result is
+    gathered without materializing a (B,H,W1,D,W2) broadcast.
+
+    Args:
+      fmap: (B, H, W, D) features.
+      x:    (B, H, W1, K) sample positions in pixels.
+
+    Returns:
+      (B, H, W1, K, D) sampled feature vectors.
+    """
+    b, h, w1, k = x.shape
+    w = fmap.shape[2]
+    x0 = jnp.floor(x)
+    frac = (x - x0).astype(fmap.dtype)[..., None]
+    x0i = x0.astype(jnp.int32).reshape(b, h, w1 * k)
+    x1i = x0i + 1
+
+    def tap(idx):
+        valid = (idx >= 0) & (idx <= w - 1)
+        safe = jnp.clip(idx, 0, w - 1)
+        v = jnp.take_along_axis(fmap, safe[..., None], axis=2)
+        return jnp.where(valid[..., None], v, jnp.zeros_like(v))
+
+    out = tap(x0i).reshape(b, h, w1, k, -1) * (1.0 - frac) \
+        + tap(x1i).reshape(b, h, w1, k, -1) * frac
+    return out

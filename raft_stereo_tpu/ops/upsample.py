@@ -1,0 +1,46 @@
+"""Convex (mask-weighted) flow upsampling.
+
+Reference: core/raft_stereo.py:55-67 — softmax over a 9-way mask per output
+subpixel, combining a 3×3 neighborhood of the coarse flow scaled by the
+upsample factor.  The reference's ``F.unfold`` + view/permute dance becomes a
+shift-stack + einsum in NHWC.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _neighborhood3x3(x: jnp.ndarray) -> jnp.ndarray:
+    """(B,H,W,C) → (B,H,W,9,C): zero-padded 3×3 neighborhoods.
+
+    Tap order matches ``F.unfold([3,3], padding=1)``: k = ky*3 + kx with
+    (ky, kx) offsets in row-major order over {-1,0,1}².
+    """
+    b, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    taps = [xp[:, ky:ky + h, kx:kx + w, :] for ky in range(3) for kx in range(3)]
+    return jnp.stack(taps, axis=3)
+
+
+def convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Upsample (B,H,W,C) flow to (B,H*factor,W*factor,C) via convex combination.
+
+    Args:
+      flow: coarse flow field, NHWC.
+      mask: (B,H,W,9*factor*factor) raw mask logits; channel layout
+            c = k*factor² + iy*factor + ix (reference: core/raft_stereo.py:59).
+      factor: integer upsample factor (2**n_downsample).
+
+    Flow VALUES are scaled by ``factor`` (disparity is measured in pixels of
+    the output resolution — reference: core/raft_stereo.py:62).
+    """
+    b, h, w, c = flow.shape
+    f = factor
+    m = mask.reshape(b, h, w, 9, f, f)
+    m = jax.nn.softmax(m, axis=3)
+    taps = _neighborhood3x3(flow * f)                      # (B,H,W,9,C)
+    up = jnp.einsum("bhwkyx,bhwkc->bhwyxc", m, taps)       # (B,H,W,f,f,C)
+    up = up.transpose(0, 1, 3, 2, 4, 5)                    # (B,H,f,W,f,C)
+    return up.reshape(b, h * f, w * f, c)

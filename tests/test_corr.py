@@ -1,0 +1,149 @@
+"""Correlation backends: cross-checked against each other and against the
+reference math re-derived in torch (the reference's implicit test strategy —
+three live implementations of one contract, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.corr import (
+    build_corr_pyramid, build_corr_volume, make_corr_fn, pool_last_axis)
+
+
+def _torch_reg_lookup(fmap1, fmap2, coords, num_levels, radius):
+    """Reference CorrBlock1D math (core/corr.py:110-156) in torch, NCHW."""
+    f1 = torch.from_numpy(fmap1).permute(0, 3, 1, 2)  # (B,D,H,W1)
+    f2 = torch.from_numpy(fmap2).permute(0, 3, 1, 2)
+    B, D, H, W1 = f1.shape
+    W2 = f2.shape[3]
+    corr = torch.einsum("aijk,aijh->ajkh", f1, f2)
+    corr = corr.reshape(B, H, W1, 1, W2) / torch.sqrt(torch.tensor(float(D)))
+    corr = corr.reshape(B * H * W1, 1, 1, W2)
+
+    pyramid = [corr]
+    for _ in range(num_levels):
+        corr = F.avg_pool2d(corr, [1, 2], stride=[1, 2])
+        pyramid.append(corr)
+
+    c = torch.from_numpy(coords)  # (B,H,W1)
+    out_pyramid = []
+    for i in range(num_levels):
+        vol = pyramid[i]
+        w = vol.shape[-1]
+        dx = torch.linspace(-radius, radius, 2 * radius + 1).view(1, 1, -1, 1)
+        x0 = dx + c.reshape(B * H * W1, 1, 1, 1) / 2 ** i
+        y0 = torch.zeros_like(x0)
+        xgrid = 2 * x0 / (w - 1) - 1
+        grid = torch.cat([xgrid, y0], dim=-1)
+        samp = F.grid_sample(vol, grid, align_corners=True)
+        out_pyramid.append(samp.view(B, H, W1, -1))
+    return torch.cat(out_pyramid, dim=-1).numpy()  # (B,H,W1,L*(2r+1))
+
+
+@pytest.fixture
+def fmaps(rng):
+    B, H, W, D = 2, 6, 40, 32
+    f1 = rng.standard_normal((B, H, W, D)).astype(np.float32)
+    f2 = rng.standard_normal((B, H, W, D)).astype(np.float32)
+    # coords roaming over and slightly beyond the valid range
+    coords = rng.uniform(-3, W + 2, size=(B, H, W)).astype(np.float32)
+    return f1, f2, coords
+
+
+def test_volume_matches_reference_einsum(fmaps):
+    f1, f2, _ = fmaps
+    got = build_corr_volume(jnp.asarray(f1), jnp.asarray(f2))
+    t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+    want = torch.einsum("aijk,aijh->ajkh", t1, t2) / np.sqrt(f1.shape[-1])
+    np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pool_last_axis_floor_semantics(rng):
+    x = rng.standard_normal((2, 3, 7)).astype(np.float32)  # odd width
+    got = pool_last_axis(jnp.asarray(x))
+    assert got.shape == (2, 3, 3)
+    want = F.avg_pool2d(torch.from_numpy(x)[:, None], [1, 2],
+                        stride=[1, 2]).numpy()[:, 0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_reg_matches_torch_reference(fmaps):
+    f1, f2, coords = fmaps
+    cfg = RaftStereoConfig(corr_levels=4, corr_radius=4, corr_backend="reg")
+    corr_fn = make_corr_fn(cfg, jnp.asarray(f1), jnp.asarray(f2))
+    got = np.asarray(corr_fn(jnp.asarray(coords)))
+    want = _torch_reg_lookup(f1, f2, coords, 4, 4)
+    assert got.shape == want.shape == (2, 6, 40, 36)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_alt_matches_reg_at_integer_coords(fmaps, rng):
+    """alt computes level-i correlation from POOLED FEATURES, reg from the
+    POOLED VOLUME — identical at level 0 and linear-combination-equal
+    elsewhere only for matching pooling, so compare level 0 exactly and all
+    levels against the torch alt reference below."""
+    f1, f2, _ = fmaps
+    B, H, W, _ = f1.shape
+    coords = rng.integers(0, W, size=(B, H, W)).astype(np.float32)
+    cfg1 = RaftStereoConfig(corr_levels=1, corr_radius=4, corr_backend="reg")
+    cfg2 = RaftStereoConfig(corr_levels=1, corr_radius=4, corr_backend="alt")
+    reg = np.asarray(make_corr_fn(cfg1, jnp.asarray(f1), jnp.asarray(f2))(
+        jnp.asarray(coords)))
+    alt = np.asarray(make_corr_fn(cfg2, jnp.asarray(f1), jnp.asarray(f2))(
+        jnp.asarray(coords)))
+    np.testing.assert_allclose(reg, alt, rtol=1e-4, atol=1e-4)
+
+
+def test_alt_matches_torch_alt(fmaps):
+    """Against PytorchAlternateCorrBlock1D math (core/corr.py:64-107)."""
+    f1, f2, coords = fmaps
+    B, H, W, D = f1.shape
+    cfg = RaftStereoConfig(corr_levels=4, corr_radius=4, corr_backend="alt")
+    got = np.asarray(make_corr_fn(cfg, jnp.asarray(f1), jnp.asarray(f2))(
+        jnp.asarray(coords)))
+
+    t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+    c = torch.from_numpy(coords)                      # (B,H,W) x positions
+    ys = torch.arange(H).float().view(1, H, 1).expand(B, H, W)
+    r = 4
+    out_pyramid = []
+    f2_i = t2
+    for i in range(4):
+        Wi = f2_i.shape[3]
+        dx = torch.linspace(-r, r, 2 * r + 1)
+        x_taps = c[..., None] / 2 ** i + dx            # (B,H,W,K)
+        xgrid = 2 * x_taps / (Wi - 1) - 1
+        ygrid = (2 * ys / (H - 1) - 1)[..., None].expand_as(xgrid)
+        corr_k = []
+        for k in range(2 * r + 1):
+            grid = torch.stack([xgrid[..., k], ygrid[..., k]], dim=-1)
+            samp = F.grid_sample(f2_i, grid, align_corners=True)
+            corr_k.append((samp * t1).sum(dim=1))
+        out_pyramid.append(torch.stack(corr_k, dim=-1) / np.sqrt(D))
+        f2_i = F.avg_pool2d(f2_i, [1, 2], stride=[1, 2])
+    want = torch.cat(out_pyramid, dim=-1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_reg_fused_falls_back_and_matches_reg(fmaps):
+    f1, f2, coords = fmaps
+    reg = make_corr_fn(RaftStereoConfig(corr_backend="reg"),
+                       jnp.asarray(f1), jnp.asarray(f2))
+    fused = make_corr_fn(RaftStereoConfig(corr_backend="reg_fused"),
+                         jnp.asarray(f1), jnp.asarray(f2))
+    np.testing.assert_allclose(np.asarray(fused(jnp.asarray(coords))),
+                               np.asarray(reg(jnp.asarray(coords))),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pyramid_shapes():
+    corr = jnp.zeros((1, 4, 10, 37))
+    pyr = build_corr_pyramid(corr, 4)
+    assert [p.shape[-1] for p in pyr] == [37, 18, 9, 4]

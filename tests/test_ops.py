@@ -1,0 +1,152 @@
+"""Unit tests for core ops, cross-checked against torch's exact semantics
+(the reference implementation's substrate) where applicable."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops import (
+    InputPadder, avg_pool2d, convex_upsample, coords_grid_x, interp_like,
+    linear_sampler_1d, linear_sampler_1d_features, pool2x,
+    resize_bilinear_align_corners, upsample_flow_bilinear,
+)
+
+
+def test_coords_grid_x():
+    g = coords_grid_x(2, 3, 5)
+    assert g.shape == (2, 3, 5)
+    np.testing.assert_array_equal(np.asarray(g[1, 2]), np.arange(5.0))
+
+
+class TestLinearSampler1D:
+    def test_hand_values(self):
+        vol = jnp.array([[0.0, 10.0, 20.0, 30.0]])
+        x = jnp.array([[0.0, 0.5, 2.25, 3.0]])
+        out = linear_sampler_1d(vol, x)
+        np.testing.assert_allclose(np.asarray(out), [[0.0, 5.0, 22.5, 30.0]])
+
+    def test_zero_padding_outside(self):
+        vol = jnp.array([[1.0, 2.0, 3.0]])
+        x = jnp.array([[-1.0, -0.5, 2.5, 3.5]])
+        out = linear_sampler_1d(vol, x)
+        # -0.5: tap at -1 is zero, tap at 0 has weight 0.5 -> 0.5
+        # 2.5: tap at 2 weight .5 (=1.5), tap at 3 zero -> 1.5
+        np.testing.assert_allclose(np.asarray(out), [[0.0, 0.5, 1.5, 0.0]])
+
+    def test_matches_grid_sample(self, rng):
+        """Reference lookup semantics: grid_sample on an H==1 image with
+        align_corners=True and zeros padding (core/utils/utils.py:59-73)."""
+        B, W2, K = 3, 17, 9
+        vol = rng.standard_normal((B, 1, 1, W2)).astype(np.float32)  # NCHW, H=1
+        x = (rng.uniform(-2, W2 + 1, size=(B, 1, K))).astype(np.float32)
+
+        xgrid = 2 * torch.from_numpy(x) / (W2 - 1) - 1
+        grid = torch.stack([xgrid, torch.zeros_like(xgrid)], dim=-1)
+        want = F.grid_sample(torch.from_numpy(vol), grid, align_corners=True)
+        want = want.numpy()[:, 0]  # (B, 1, K)
+
+        got = linear_sampler_1d(jnp.asarray(vol[:, 0]), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+class TestResize:
+    @pytest.mark.parametrize("src,dst", [((6, 8), (12, 16)), ((7, 5), (3, 9)),
+                                         ((4, 4), (4, 4)), ((5, 6), (1, 1))])
+    def test_matches_torch_interpolate(self, rng, src, dst):
+        x = rng.standard_normal((2, *src, 3)).astype(np.float32)
+        want = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2),
+                             size=dst, mode="bilinear", align_corners=True)
+        want = want.permute(0, 2, 3, 1).numpy()
+        got = resize_bilinear_align_corners(jnp.asarray(x), dst)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_interp_like(self, rng):
+        x = jnp.asarray(rng.standard_normal((1, 4, 4, 2)).astype(np.float32))
+        dest = jnp.zeros((1, 8, 6, 5))
+        assert interp_like(x, dest).shape == (1, 8, 6, 2)
+
+    def test_upflow(self, rng):
+        """Reference: core/utils/utils.py:82-84 (upflow8 = resize + scale)."""
+        f = rng.standard_normal((1, 3, 4, 1)).astype(np.float32)
+        want = 8 * F.interpolate(torch.from_numpy(f).permute(0, 3, 1, 2),
+                                 size=(24, 32), mode="bilinear",
+                                 align_corners=True)
+        got = upsample_flow_bilinear(jnp.asarray(f), 8)
+        np.testing.assert_allclose(np.asarray(got)[..., 0],
+                                   want.numpy()[:, 0], rtol=1e-5, atol=1e-5)
+
+
+class TestPooling:
+    def test_pool2x_matches_torch(self, rng):
+        x = rng.standard_normal((2, 7, 9, 4)).astype(np.float32)
+        want = F.avg_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), 3,
+                            stride=2, padding=1).permute(0, 2, 3, 1).numpy()
+        got = pool2x(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_avg_pool2d_matches_torch(self, rng):
+        x = rng.standard_normal((1, 16, 16, 2)).astype(np.float32)
+        want = F.avg_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), 5,
+                            stride=4, padding=1).permute(0, 2, 3, 1).numpy()
+        got = avg_pool2d(jnp.asarray(x), (5, 5), (4, 4), ((1, 1), (1, 1)))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_feature_sampler_agrees_with_scalar_sampler(self, rng):
+        """linear_sampler_1d_features must stay in sync with
+        linear_sampler_1d (same boundary semantics)."""
+        fmap = rng.standard_normal((2, 3, 11, 4)).astype(np.float32)
+        x = rng.uniform(-2, 13, size=(2, 3, 5, 7)).astype(np.float32)
+        got = linear_sampler_1d_features(jnp.asarray(fmap), jnp.asarray(x))
+        # scalar sampler per feature channel
+        vol = jnp.moveaxis(jnp.asarray(fmap), -1, 0)       # (D,B,H,W)
+        for d in range(fmap.shape[-1]):
+            want = linear_sampler_1d(vol[d][:, :, None, :],
+                                     jnp.asarray(x))        # (B,H,W1,K)
+            np.testing.assert_allclose(np.asarray(got[..., d]),
+                                       np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+class TestConvexUpsample:
+    @pytest.mark.parametrize("factor", [2, 4, 8])
+    def test_matches_reference_math(self, rng, factor):
+        """Re-derive the reference's unfold/view/permute math in torch
+        (core/raft_stereo.py:55-67) and compare."""
+        B, H, W = 2, 5, 6
+        flow_np = rng.standard_normal((B, H, W, 1)).astype(np.float32)
+        mask_np = rng.standard_normal((B, H, W, 9 * factor * factor)).astype(np.float32)
+
+        flow_t = torch.from_numpy(flow_np).permute(0, 3, 1, 2)  # (B,1,H,W)
+        # torch mask layout is NCHW: (B, 9*f*f, H, W)
+        mask_t = torch.from_numpy(mask_np).permute(0, 3, 1, 2)
+        m = mask_t.view(B, 1, 9, factor, factor, H, W)
+        m = torch.softmax(m, dim=2)
+        up = F.unfold(factor * flow_t, [3, 3], padding=1)
+        up = up.view(B, 1, 9, 1, 1, H, W)
+        up = torch.sum(m * up, dim=2)
+        up = up.permute(0, 1, 4, 2, 5, 3)
+        want = up.reshape(B, 1, factor * H, factor * W).numpy()
+
+        got = convex_upsample(jnp.asarray(flow_np), jnp.asarray(mask_np), factor)
+        np.testing.assert_allclose(np.asarray(got)[..., 0], want[:, 0],
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestInputPadder:
+    @pytest.mark.parametrize("mode", ["sintel", "other"])
+    @pytest.mark.parametrize("hw", [(375, 1242), (448, 448), (13, 29)])
+    def test_matches_torch_replicate(self, rng, mode, hw):
+        x = rng.standard_normal((1, *hw, 3)).astype(np.float32)
+        padder = InputPadder((1, *hw, 3), mode=mode, divis_by=32)
+        (padded,) = padder.pad(jnp.asarray(x))
+        assert padded.shape[1] % 32 == 0 and padded.shape[2] % 32 == 0
+
+        # torch reference pads NCHW with (l, r, t, b)
+        t = torch.from_numpy(x).permute(0, 3, 1, 2)
+        want = F.pad(t, padder._pad, mode="replicate").permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(padded), want.numpy())
+
+        back = padder.unpad(padded)
+        np.testing.assert_allclose(np.asarray(back), x)
