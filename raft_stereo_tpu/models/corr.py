@@ -54,12 +54,17 @@ def build_corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     return corr / math.sqrt(d)
 
 
-def pool_last_axis(x: jnp.ndarray) -> jnp.ndarray:
-    """(… , W) → (…, W//2): 2-wide stride-2 mean along the last axis
-    (reference: core/corr.py:124 ``F.avg_pool2d([1,2])``, floor semantics)."""
-    w2 = (x.shape[-1] // 2) * 2
-    x = x[..., :w2]
-    return 0.5 * (x[..., 0::2] + x[..., 1::2])
+def pool_axis(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """2-wide stride-2 mean along ``axis``, floor semantics
+    (reference: core/corr.py:124 ``F.avg_pool2d([1,2])``)."""
+    axis = axis % x.ndim
+    w2 = (x.shape[axis] // 2) * 2
+    lo = x[(slice(None),) * axis + (slice(0, w2, 2),)]
+    hi = x[(slice(None),) * axis + (slice(1, w2, 2),)]
+    return 0.5 * (lo + hi)
+
+
+pool_last_axis = pool_axis
 
 
 def build_corr_pyramid(corr: jnp.ndarray, num_levels: int) -> List[jnp.ndarray]:
@@ -108,9 +113,7 @@ def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
     # Progressively W-pooled right features (reference: core/corr.py:104).
     fmap2_pyramid = [fmap2]
     for _ in range(cfg.corr_levels - 1):
-        f = fmap2_pyramid[-1]
-        w2 = (f.shape[2] // 2) * 2
-        fmap2_pyramid.append(0.5 * (f[:, :, 0:w2:2] + f[:, :, 1:w2:2]))
+        fmap2_pyramid.append(pool_axis(fmap2_pyramid[-1], axis=2))
 
     def corr_fn(coords):
         outs = []

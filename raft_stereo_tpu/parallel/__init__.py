@@ -1,0 +1,4 @@
+from raft_stereo_tpu.parallel.mesh import (DATA_AXIS, CORR_AXIS, make_mesh,
+                                           shard_batch, replicate)
+
+__all__ = ["DATA_AXIS", "CORR_AXIS", "make_mesh", "shard_batch", "replicate"]

@@ -1,0 +1,72 @@
+"""Device mesh + sharding helpers.
+
+TPU-native replacement for the reference's ``nn.DataParallel``
+(reference: train_stereo.py:134 — single-process replicate/scatter/gather).
+Here parallelism is SPMD: one jitted program over a ``jax.sharding.Mesh``,
+batches sharded along ``data``, params replicated; XLA inserts the gradient
+``psum`` over ICI automatically from sharding propagation.
+
+The ``corr`` axis is reserved for sharding the W2 (disparity-search) axis of
+the correlation volume — the "long-context" analog for full-resolution inputs
+(SURVEY.md §5).  It is wired up by ``parallel/corr_sharded.py``; plain
+data-parallel training should use ``n_corr=1``.
+
+Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh`` — the
+mesh then spans all hosts' devices and data loading shards per-process
+(``process_index``-strided), with gradient collectives riding ICI within a
+slice and DCN across slices.  Nothing else changes; that is the point of SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+CORR_AXIS = "corr"
+
+
+def make_mesh(n_data: int = 0, n_corr: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(data, corr)`` mesh.
+
+    Args:
+      n_data: devices along the batch axis; 0 = all remaining devices.
+      n_corr: devices sharding the disparity-search (W2) axis.
+      devices: explicit device list (default ``jax.devices()``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n_data <= 0:
+        if len(devices) % n_corr:
+            raise ValueError(f"{len(devices)} devices not divisible by "
+                             f"n_corr={n_corr}")
+        n_data = len(devices) // n_corr
+    n = n_data * n_corr
+    if n > len(devices):
+        raise ValueError(f"mesh wants {n_data}×{n_corr}={n} devices but only "
+                         f"{len(devices)} are available")
+    if n < len(devices):
+        import warnings
+        warnings.warn(f"mesh uses {n} of {len(devices)} devices; "
+                      f"{len(devices) - n} will sit idle", stacklevel=2)
+    grid = np.asarray(devices[:n]).reshape(n_data, n_corr)
+    return Mesh(grid, (DATA_AXIS, CORR_AXIS))
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place a host batch on the mesh, sharded along the leading (batch) dim."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree over the mesh (params / train state)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,6 @@
+from raft_stereo_tpu.training.loss import sequence_loss
+from raft_stereo_tpu.training.optimizer import make_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.state import TrainState, create_train_state
+
+__all__ = ["sequence_loss", "make_optimizer", "one_cycle_lr", "TrainState",
+           "create_train_state"]

@@ -1,0 +1,83 @@
+"""Checkpoint I/O (orbax) — full train-state saves with a self-describing config.
+
+The reference saves weights only, with ``DataParallel``'s ``module.`` key
+prefix baked in, forcing every consumer to re-wrap the model just to load it
+(reference: train_stereo.py:184-186, evaluate_stereo.py:210, demo.py:24-27) and
+making exact resume impossible.  Here a checkpoint directory holds:
+
+* ``state/``      — orbax pytree: params, batch_stats, opt_state, step
+  (or params + batch_stats only, for inference exports)
+* ``config.json`` — the model architecture (RaftStereoConfig), so loading
+  never requires re-supplying the right CLI flags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+
+CONFIG_FILE = "config.json"
+STATE_DIR = "state"
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def save_checkpoint(path: str, model_cfg: RaftStereoConfig,
+                    state_tree: Dict[str, Any]) -> None:
+    """Save ``state_tree`` (any pytree of arrays) + the model config."""
+    path = _abs(path)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, CONFIG_FILE), "w") as f:
+        f.write(model_cfg.to_json())
+    ckptr = ocp.StandardCheckpointer()
+    state_path = os.path.join(path, STATE_DIR)
+    ckptr.save(state_path, jax.device_get(state_tree), force=True)
+    ckptr.wait_until_finished()
+
+
+def load_config(path: str) -> RaftStereoConfig:
+    with open(os.path.join(_abs(path), CONFIG_FILE)) as f:
+        return RaftStereoConfig.from_json(f.read())
+
+
+def load_checkpoint(path: str, target: Optional[Any] = None
+                    ) -> Tuple[RaftStereoConfig, Any]:
+    """Restore ``(model_cfg, state_tree)``.
+
+    ``target`` (optional) is an example pytree used to restore with matching
+    structure/dtypes — pass the output of ``create_train_state`` /
+    ``init_model_variables`` for exact-resume restores.
+    """
+    path = _abs(path)
+    cfg = load_config(path)
+    ckptr = ocp.StandardCheckpointer()
+    state_path = os.path.join(path, STATE_DIR)
+    if target is not None:
+        restored = ckptr.restore(state_path, target=jax.device_get(target))
+    else:
+        restored = ckptr.restore(state_path)
+    return cfg, restored
+
+
+def save_weights(path: str, model_cfg: RaftStereoConfig, params: Any,
+                 batch_stats: Any = None) -> None:
+    """Inference export: weights + config only (≙ the reference's .pth zoo)."""
+    tree = {"params": params, "batch_stats": batch_stats or {}}
+    save_checkpoint(path, model_cfg, tree)
+
+
+def load_weights(path: str) -> Tuple[RaftStereoConfig, Dict[str, Any]]:
+    """Load an inference export as flax ``variables``."""
+    cfg, tree = load_checkpoint(path)
+    variables = {"params": tree["params"]}
+    if tree.get("batch_stats"):
+        variables["batch_stats"] = tree["batch_stats"]
+    return cfg, variables

@@ -1,0 +1,57 @@
+"""Sequence loss over per-iteration predictions (reference: train_stereo.py:35-69).
+
+Our model emits a stacked ``(iters, B, H, W)`` array of x-flow predictions
+(scan ys) instead of the reference's Python list of 2-channel flow maps; the
+y component is identically zero by the epipolar projection so the L1/EPE math
+is unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+
+def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
+                  valid: jnp.ndarray, loss_gamma: float = 0.9,
+                  max_flow: float = 700.0
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Exponentially-weighted L1 over all iteration outputs.
+
+    Args:
+      flow_preds: (iters, B, H, W) per-iteration x-flow predictions.
+      flow_gt: (B, H, W) ground-truth x-flow (= -disparity).
+      valid: (B, H, W) validity in {0, 1} (or a float mask thresholded at 0.5).
+      loss_gamma: base decay; the exponent is renormalized so the schedule is
+        invariant to the iteration count (reference: train_stereo.py:52-54).
+      max_flow: exclude pixels with |flow| >= max_flow
+        (reference: train_stereo.py:43-46).
+
+    Returns:
+      (scalar loss, metrics dict with epe / 1px / 3px / 5px from the final
+      prediction — reference: train_stereo.py:59-67).
+    """
+    n_predictions = flow_preds.shape[0]
+    # gamma adjusted to the number of predictions so e.g. 12 and 22 train
+    # iters see the same effective schedule.
+    gamma_adj = loss_gamma ** (15.0 / max(n_predictions - 1, 1))
+
+    mask = (valid >= 0.5) & (jnp.abs(flow_gt) < max_flow)  # (B, H, W)
+    maskf = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(maskf), 1.0)
+
+    abs_err = jnp.abs(flow_preds - flow_gt[None])          # (iters, B, H, W)
+    per_iter = jnp.sum(abs_err * maskf[None], axis=(1, 2, 3)) / denom
+    weights = gamma_adj ** jnp.arange(n_predictions - 1, -1, -1,
+                                      dtype=jnp.float32)
+    flow_loss = jnp.sum(weights * per_iter)
+
+    epe = abs_err[-1]  # 1-D flow ⇒ EPE is the absolute error
+    metrics = {
+        "epe": jnp.sum(epe * maskf) / denom,
+        "1px": jnp.sum((epe < 1) * maskf) / denom,
+        "3px": jnp.sum((epe < 3) * maskf) / denom,
+        "5px": jnp.sum((epe < 5) * maskf) / denom,
+    }
+    return flow_loss, metrics

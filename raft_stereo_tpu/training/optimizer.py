@@ -1,0 +1,52 @@
+"""Optimizer and LR schedule (reference: train_stereo.py:72-79).
+
+AdamW + one-cycle linear schedule: warm up from ``peak/div_factor`` over
+``pct_start`` of training, then anneal linearly to
+``peak/(div_factor*final_div_factor)`` — the torch ``OneCycleLR`` two-phase
+shape with ``anneal_strategy='linear'``, ``cycle_momentum=False``.  Gradients
+are clipped to global-norm 1.0 before the update (reference:
+train_stereo.py:174-177).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from raft_stereo_tpu.config import TrainConfig
+
+
+def one_cycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.01,
+                 div_factor: float = 25.0, final_div_factor: float = 1e4):
+    """Piecewise-linear one-cycle schedule (torch OneCycleLR, linear anneal)."""
+    initial = peak_lr / div_factor
+    final = initial / final_div_factor
+    # torch phase boundaries: peak at step pct_start*total - 1, final LR at
+    # step total - 1.
+    peak_step = max(float(pct_start * total_steps) - 1.0, 1.0)
+    last_step = float(total_steps - 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = initial + (peak_lr - initial) * (step / peak_step)
+        frac = (step - peak_step) / max(last_step - peak_step, 1.0)
+        down = peak_lr + (final - peak_lr) * jnp.clip(frac, 0.0, 1.0)
+        return jnp.where(step < peak_step, up, down)
+
+    return schedule
+
+
+def make_optimizer(cfg: TrainConfig):
+    """Clip-by-global-norm → AdamW with the one-cycle schedule.
+
+    The schedule runs over ``num_steps + 100`` like the reference
+    (train_stereo.py:77) so the final LR is never reached in training.
+    Returns ``(tx, schedule)``.
+    """
+    schedule = one_cycle_lr(cfg.lr, cfg.num_steps + 100)
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.clip_grad_norm),
+        optax.adamw(schedule, b1=0.9, b2=0.999, eps=cfg.epsilon,
+                    weight_decay=cfg.wdecay),
+    )
+    return tx, schedule

@@ -1,0 +1,52 @@
+"""Train state: params + frozen batch stats + optimizer state.
+
+Improves on the reference's weights-only ``torch.save(state_dict)``
+(train_stereo.py:184-186 — no optimizer/scheduler/step ⇒ no exact resume):
+the full state here round-trips through the checkpointer, so training resumes
+bit-exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax.training import train_state
+
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + the non-trainable ``batch_stats`` collection.
+
+    BatchNorm is frozen throughout training (reference: train_stereo.py:151,193)
+    so ``batch_stats`` never updates during a step — it exists to carry imported
+    running statistics from reference checkpoints.
+    """
+
+    batch_stats: Any = None
+
+
+def init_model_variables(model_cfg: RaftStereoConfig, rng: jax.Array,
+                         image_shape=(1, 64, 96, 3)) -> Dict[str, Any]:
+    model = RAFTStereo(model_cfg)
+    dummy = jnp.zeros(image_shape, jnp.float32)
+    return model.init(rng, dummy, dummy, iters=1, test_mode=True)
+
+
+def create_train_state(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
+                       rng: jax.Array,
+                       image_shape=(1, 64, 96, 3)) -> TrainState:
+    from raft_stereo_tpu.training.optimizer import make_optimizer
+
+    model = RAFTStereo(model_cfg)
+    variables = init_model_variables(model_cfg, rng, image_shape)
+    tx, _ = make_optimizer(train_cfg)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        tx=tx,
+    )
