@@ -1,0 +1,83 @@
+"""Benchmark: realtime-config RAFT-Stereo inference FPS at KITTI resolution.
+
+Replicates the reference's FPS protocol (reference: evaluate_stereo.py:77-82,
+105-107): test-mode forward, inputs padded to /32 (375x1242 -> 384x1248),
+warmup discarded, FPS = 1 / mean(per-image runtime).  Model is the realtime
+configuration (reference: README.md:84 — shared backbone, n_downsample 3,
+2 GRU layers, slow-fast, 7 iters, mixed precision).
+
+Timing method: the device may sit behind an async tunnel where
+``block_until_ready`` returns at dispatch, so per-call host timing lies.
+Instead we chain K forwards on-device in a ``lax.fori_loop`` (inputs perturbed
+per-iteration so nothing folds away), fetch a scalar, and difference two K
+values to cancel dispatch/round-trip overhead:
+    per_image = (t(K_hi) - t(K_lo)) / (K_hi - K_lo)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` divides by 26 FPS — the reference paper's realtime-model
+RTX-6000 claim (arXiv 2109.07547; external, see BASELINE.md).  North star
+(BASELINE.json): vs_baseline >= 4.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_FPS = 26.0  # reference realtime model on RTX 6000 (paper claim)
+KITTI_PADDED = (384, 1248)  # 375x1242 padded to /32 (evaluate_stereo.py:73)
+K_LO, K_HI = 3, 23
+REPEATS = 3
+
+
+def main():
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig.realtime()
+    model = RAFTStereo(cfg)
+
+    h, w = KITTI_PADDED
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+
+    variables = jax.jit(
+        lambda r: model.init(r, img1[:, :64, :96], img2[:, :64, :96],
+                             iters=1, test_mode=True)
+    )(jax.random.PRNGKey(0))
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def chain(variables, image1, image2, k):
+        def body(i, acc):
+            _, up = model.apply(variables, image1 + i * 1e-6, image2,
+                                iters=7, test_mode=True)
+            return acc + jnp.mean(up)
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    def timed(k):
+        t0 = time.perf_counter()
+        float(chain(variables, img1, img2, k))  # scalar fetch = full sync
+        return time.perf_counter() - t0
+
+    for k in (K_LO, K_HI):  # compile (ref's 50-image warmup analog)
+        timed(k)
+
+    per_image = min((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO)
+                    for _ in range(REPEATS))
+    fps = 1.0 / per_image
+    print(json.dumps({
+        "metric": "realtime_model_inference_fps_kitti_res",
+        "value": round(fps, 2),
+        "unit": "frames/s",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

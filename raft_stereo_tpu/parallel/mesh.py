@@ -59,8 +59,18 @@ def make_mesh(n_data: int = 0, n_corr: int = 1,
 
 
 def shard_batch(batch: Any, mesh: Mesh) -> Any:
-    """Place a host batch on the mesh, sharded along the leading (batch) dim."""
+    """Place a host batch on the mesh, sharded along the leading (batch) dim.
+
+    Single-host: a plain ``device_put``.  Multi-host (mesh spans processes):
+    each process passes its LOCAL shard of the global batch — leading dim =
+    global_batch // process_count — and the global array is assembled with
+    ``jax.make_array_from_process_local_data`` (``device_put`` cannot place a
+    host-local array onto another process's devices)."""
     sharding = NamedSharding(mesh, P(DATA_AXIS))
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)
 

@@ -22,7 +22,15 @@ def one_cycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.01,
     initial = peak_lr / div_factor
     final = initial / final_div_factor
     # torch phase boundaries: peak at step pct_start*total - 1, final LR at
-    # step total - 1.
+    # step total - 1.  The warmup phase needs pct_start*total >= 2 to exist;
+    # shorter runs would clamp it to a single step and diverge from torch.
+    if pct_start * total_steps < 2.0:
+        import warnings
+        warnings.warn(
+            f"one_cycle_lr: pct_start*total_steps = {pct_start * total_steps:.1f}"
+            " < 2 leaves no real warmup phase — LR jumps to peak after one"
+            " step and torch OneCycleLR equivalence does not hold (fine for"
+            " smoke tests, not for real training)", stacklevel=2)
     peak_step = max(float(pct_start * total_steps) - 1.0, 1.0)
     last_step = float(total_steps - 1)
 

@@ -32,9 +32,12 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
     (= -disparity), valid (B,H,W) in {0,1}.
     """
 
+    # Tolerate states built without create_train_state (batch_stats=None).
+    batch_stats = state.batch_stats if state.batch_stats is not None else {}
+
     def loss_fn(params):
         preds = state.apply_fn(
-            {"params": params, "batch_stats": state.batch_stats},
+            {"params": params, "batch_stats": batch_stats},
             batch["image1"], batch["image2"], iters=iters)
         loss, metrics = sequence_loss(preds, batch["flow"], batch["valid"],
                                       loss_gamma=loss_gamma, max_flow=max_flow)
