@@ -1,0 +1,3 @@
+from raft_stereo_tpu.data.datasets import (DATASETS, StereoDataset,
+                                           build_training_mixture)
+from raft_stereo_tpu.data.loader import StereoLoader

@@ -1,0 +1,139 @@
+"""Host-side batch loader: shuffled, threaded, prefetching.
+
+TPU-native replacement for the reference's ``torch.utils.data.DataLoader``
+(reference: core/stereo_datasets.py:311-312): decode + augment run on host
+CPU threads while the device steps; batches are stacked NHWC NumPy dicts
+ready for ``shard_batch``.  Threads (not processes) because the decode path
+is NumPy/cv2 releasing the GIL; the native C++ decode path slots in below.
+
+Determinism: the epoch-``e`` permutation comes from ``seed + e`` and each
+sample's augmentation RNG from ``(seed, epoch, index)`` (see datasets.py), so
+a (seed, step) pair maps to one exact batch regardless of thread scheduling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from raft_stereo_tpu.data.datasets import StereoDataset
+
+
+class StereoLoader:
+    """Iterate device-ready batches forever (training) or one epoch (eval).
+
+    Args:
+      dataset: a ``StereoDataset`` (samples must share one crop size).
+      batch_size: global batch size; ``drop_last`` semantics always on.
+      shuffle: re-permute every epoch with ``seed + epoch``.
+      num_workers: decode threads; 0 = synchronous in-caller decode.
+      prefetch: max ready batches buffered ahead.
+      epochs: None = loop forever.
+    """
+
+    def __init__(self, dataset: StereoDataset, batch_size: int,
+                 shuffle: bool = True, num_workers: int = 4,
+                 prefetch: int = 2, seed: int = 1234,
+                 epochs: Optional[int] = None):
+        if len(dataset) < batch_size:
+            raise ValueError(
+                f"dataset has {len(dataset)} samples < batch_size={batch_size}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = num_workers
+        self.prefetch = prefetch
+        self.seed = seed
+        self.epochs = epochs
+
+    def __len__(self) -> int:
+        return len(self.dataset) // self.batch_size  # drop_last
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.dataset))
+        return np.random.default_rng(self.seed + epoch).permutation(
+            len(self.dataset))
+
+    def _make_batch(self, epoch: int, indices: np.ndarray
+                    ) -> Dict[str, np.ndarray]:
+        samples = [self.dataset.__getitem__(int(i), epoch) for i in indices]
+        return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.num_workers <= 0:
+            yield from self._iter_sync()
+        else:
+            yield from self._iter_threaded()
+
+    def _batch_indices(self):
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            order = self._epoch_order(epoch)
+            for i in range(len(self)):
+                yield epoch, order[i * self.batch_size:
+                                   (i + 1) * self.batch_size]
+            epoch += 1
+
+    def _iter_sync(self):
+        for epoch, idx in self._batch_indices():
+            yield self._make_batch(epoch, idx)
+
+    def _iter_threaded(self):
+        """Workers claim batch slots from a ticket queue and publish into a
+        bounded reorder buffer, so batch order stays deterministic while
+        decode runs ahead."""
+        tickets: "queue.Queue" = queue.Queue()
+        done = threading.Event()
+        results: Dict[int, Dict[str, np.ndarray]] = {}
+        results_lock = threading.Condition()
+        max_ahead = self.prefetch + self.num_workers
+
+        def worker():
+            while not done.is_set():
+                try:
+                    seq, epoch, idx = tickets.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    batch = self._make_batch(epoch, idx)
+                except Exception as e:  # surface decode errors to the consumer
+                    batch = e
+                with results_lock:
+                    results[seq] = batch
+                    results_lock.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+
+        try:
+            gen = self._batch_indices()
+            issued = 0
+            consumed = 0
+            exhausted = False
+            while True:
+                while not exhausted and issued < consumed + max_ahead:
+                    try:
+                        epoch, idx = next(gen)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    tickets.put((issued, epoch, idx))
+                    issued += 1
+                if exhausted and consumed == issued:
+                    return
+                with results_lock:
+                    while consumed not in results:
+                        results_lock.wait(timeout=0.5)
+                    batch = results.pop(consumed)
+                consumed += 1
+                if isinstance(batch, Exception):
+                    raise batch
+                yield batch
+        finally:
+            done.set()
