@@ -1,0 +1,4 @@
+from raft_stereo_tpu.eval.runner import InferenceRunner
+from raft_stereo_tpu.eval.validate import (validate_eth3d, validate_kitti,
+                                           validate_middlebury,
+                                           validate_things)

@@ -1,0 +1,112 @@
+"""The four validation harnesses + the KITTI FPS benchmark protocol.
+
+One generic loop parameterized by each benchmark's quirks, reproducing the
+reference's metric definitions exactly (reference: evaluate_stereo.py:19-189):
+
+| benchmark   | bad-px thr | valid mask                         | D1 aggregation |
+|-------------|-----------:|------------------------------------|----------------|
+| ETH3D       |        1.0 | valid >= 0.5                       | per-image mean |
+| KITTI-2015  |        3.0 | valid >= 0.5                       | per-PIXEL pool |
+| FlyingThings|        1.0 | valid >= 0.5 and |flow| < 192      | per-PIXEL pool |
+| Middlebury  |        2.0 | valid >= -0.5 (occluded INCLUDED)  | per-image mean |
+|             |            |   and flow > -1000                 |                |
+
+KITTI additionally times each forward and reports FPS with the first 50
+images discarded as warmup (evaluate_stereo.py:77-82,105-107) — under jit
+the warmup absorbs XLA compilation instead of cuDNN autotuning.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from raft_stereo_tpu.data import datasets as ds
+from raft_stereo_tpu.eval.runner import InferenceRunner
+
+log = logging.getLogger(__name__)
+
+WARMUP_IMAGES = 50
+
+
+def _validate(runner: InferenceRunner, dataset, name: str,
+              bad_threshold: float,
+              valid_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+              pixel_pool_d1: bool, timed: bool = False,
+              max_images: Optional[int] = None) -> Dict[str, float]:
+    epe_list, out_list, elapsed = [], [], []
+    n = len(dataset) if max_images is None else min(len(dataset), max_images)
+    for i in range(n):
+        sample = dataset[i]
+        flow_gt = sample["flow"]
+        valid_gt = sample["valid"]
+        flow_pr, secs = runner(sample["image1"], sample["image2"])
+        assert flow_pr.shape == flow_gt.shape, (flow_pr.shape, flow_gt.shape)
+        if timed and i > WARMUP_IMAGES:
+            elapsed.append(secs)
+
+        epe = np.abs(flow_pr - flow_gt).ravel()
+        val = valid_fn(valid_gt.ravel(), flow_gt.ravel())
+        bad = epe > bad_threshold
+        image_epe = float(epe[val].mean())
+        image_bad = float(bad[val].mean())
+        log.info("%s %d/%d. EPE %.4f D1 %.4f", name, i + 1, n,
+                 image_epe, image_bad)
+        epe_list.append(image_epe)
+        out_list.append(bad[val] if pixel_pool_d1 else image_bad)
+
+    epe = float(np.mean(epe_list))
+    d1 = 100 * float(np.mean(np.concatenate(out_list) if pixel_pool_d1
+                             else np.asarray(out_list)))
+    result = {f"{name}-epe": epe, f"{name}-d1": d1}
+    if timed and elapsed:
+        mean_rt = float(np.mean(elapsed))
+        result[f"{name}-fps"] = 1.0 / mean_rt
+        print(f"Validation {name}: EPE {epe}, D1 {d1}, "
+              f"{1.0 / mean_rt:.2f}-FPS ({mean_rt:.3f}s)")
+    else:
+        print(f"Validation {name}: EPE {epe}, D1 {d1}")
+    return result
+
+
+def validate_eth3d(runner: InferenceRunner, root: str = "datasets/ETH3D",
+                   max_images: Optional[int] = None) -> Dict[str, float]:
+    """ETH3D two-view training split (reference: evaluate_stereo.py:19-57)."""
+    return _validate(runner, ds.ETH3D(root=root), "eth3d", 1.0,
+                     lambda v, f: v >= 0.5, pixel_pool_d1=False,
+                     max_images=max_images)
+
+
+def validate_kitti(runner: InferenceRunner, root: str = "datasets/KITTI",
+                   max_images: Optional[int] = None) -> Dict[str, float]:
+    """KITTI-2015 training split; also the FPS harness
+    (reference: evaluate_stereo.py:60-109)."""
+    return _validate(runner, ds.KITTI(root=root), "kitti", 3.0,
+                     lambda v, f: v >= 0.5, pixel_pool_d1=True, timed=True,
+                     max_images=max_images)
+
+
+def validate_things(runner: InferenceRunner, root: str = "datasets",
+                    dstype: str = "frames_finalpass",
+                    max_images: Optional[int] = None) -> Dict[str, float]:
+    """FlyingThings3D TEST subset (reference: evaluate_stereo.py:112-147)."""
+    return _validate(
+        runner, ds.SceneFlow(root=root, dstype=dstype, things_test=True),
+        "things", 1.0,
+        lambda v, f: (v >= 0.5) & (np.abs(f) < 192),
+        pixel_pool_d1=True, max_images=max_images)
+
+
+def validate_middlebury(runner: InferenceRunner,
+                        root: str = "datasets/Middlebury", split: str = "F",
+                        max_images: Optional[int] = None) -> Dict[str, float]:
+    """MiddEval3 training set; the valid mask keeps OCCLUDED pixels
+    (valid >= -0.5 passes the 0/1 nocc mask entirely) and drops only
+    unknown-GT pixels (flow > -1000) — reference: evaluate_stereo.py:173-175."""
+    return _validate(
+        runner, ds.Middlebury(root=root, split=split),
+        f"middlebury{split}", 2.0,
+        lambda v, f: (v >= -0.5) & (f > -1000),
+        pixel_pool_d1=False, max_images=max_images)

@@ -1,0 +1,2 @@
+from raft_stereo_tpu.io.torch_import import (import_torch_checkpoint,
+                                             infer_config_from_state_dict)

@@ -1,0 +1,233 @@
+"""Import the reference's PyTorch ``.pth`` checkpoints into flax variables.
+
+This is the product feature that unlocks the published model zoo
+(raftstereo-{middlebury,eth3d,sceneflow,realtime}.pth).  Handles:
+
+* the ``module.`` prefix torch ``DataParallel`` bakes into every key
+  (reference: train_stereo.py:134,184-186),
+* OIHW → HWIO conv-kernel transposes (NCHW torch → NHWC TPU),
+* BatchNorm split into params (scale/bias) + batch_stats (mean/var),
+* the reference's aliased ``downsample.1`` == ``norm3`` duplicate keys
+  (reference: core/extractor.py:44-45 registers one module twice),
+* params the reference allocates but never uses at n_gru_layers < 3
+  (``gru32``/``layer5``/``outputs32`` exist unconditionally —
+  core/update.py:104-106, core/extractor.py:226-252),
+* the hidden-dims index-convention flip (reference indexes coarse→fine in
+  the update block; we index fine→coarse everywhere — see config.py).
+
+Import is validated by construction: every translated tensor must land on
+an existing leaf with the exact shape, and every target leaf must be filled.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu.config import RaftStereoConfig
+
+log = logging.getLogger(__name__)
+
+_SKIP_SUFFIXES = ("num_batches_tracked",)
+
+
+def _load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(raw, dict) and "state_dict" in raw:
+        raw = raw["state_dict"]
+    out = {}
+    for k, v in raw.items():
+        k = k.removeprefix("module.")
+        out[k] = v.detach().numpy()
+    return out
+
+
+def infer_config_from_state_dict(state: Dict[str, np.ndarray],
+                                 **overrides) -> RaftStereoConfig:
+    """Derive what the weights determine; take the rest from ``overrides``.
+
+    Inferable: n_downsample (mask-head output channels = 9·4^n),
+    n_gru_layers (context_zqr_convs ModuleList length), shared_backbone
+    (``conv2.0.*`` Sequential keys present / ``fnet.*`` absent), hidden and
+    context dims (gru/zqr conv shapes).  NOT inferable (runtime-only flags):
+    slow_fast_gru, corr_backend, corr_levels/radius split (36 channels is
+    consistent with several (levels, radius) pairs), mixed_precision.
+    """
+    mask_out = state["update_block.mask.2.weight"].shape[0]
+    n_downsample = {9 * 16: 2, 9 * 64: 3, 9 * 4: 1}[mask_out]
+    n_gru = len({m.group(1) for k in state
+                 if (m := re.match(r"context_zqr_convs\.(\d+)\.", k))})
+    shared = not any(k.startswith("fnet.") for k in state)
+    # context_zqr_convs.i maps level i fine→coarse: out = 3*hidden_dims[i]
+    hidden_dims = tuple(
+        state[f"context_zqr_convs.{i}.weight"].shape[0] // 3
+        for i in range(n_gru))
+    context_dims = tuple(
+        state[f"context_zqr_convs.{i}.weight"].shape[1]
+        for i in range(n_gru))
+    # pad unused coarse levels so len(hidden_dims) stays 3 when possible
+    while len(hidden_dims) < 3:
+        hidden_dims += (hidden_dims[-1],)
+        context_dims += (context_dims[-1],)
+    defaults = dict(hidden_dims=hidden_dims, context_dims=context_dims,
+                    n_gru_layers=n_gru, n_downsample=n_downsample,
+                    shared_backbone=shared)
+    defaults.update(overrides)
+    return RaftStereoConfig(**defaults)
+
+
+_RES_INNER = {"conv1": "conv1", "conv2": "conv2", "norm1": "norm1",
+              "norm2": "norm2", "norm3": "norm3"}
+
+
+def _translate_residual(parts) -> Optional[Tuple[str, ...]]:
+    """ResidualBlock inner names; returns None for keys to skip."""
+    head = parts[0]
+    if head == "downsample":
+        if parts[1] == "0":
+            return ("downsample_conv",) + tuple(parts[2:])
+        return None  # downsample.1 duplicates norm3
+    if head in _RES_INNER:
+        return (head,) + tuple(parts[1:])
+    raise KeyError(f"unknown residual-block member {parts}")
+
+
+def _translate(key: str) -> Optional[Tuple[str, ...]]:
+    """torch state-dict key (module. stripped) → our module path (no leaf)."""
+    parts = key.split(".")
+    root = parts[0]
+
+    if root in ("cnet", "fnet"):
+        sub = parts[1]
+        if sub in ("conv1", "norm1"):
+            return (root, "trunk", sub) + tuple(parts[2:])
+        m = re.fullmatch(r"layer([1-5])", sub)
+        if m:
+            layer, block = m.group(1), parts[2]
+            name = f"layer{layer}_{block}"
+            inner = _translate_residual(parts[3:])
+            if inner is None:
+                return None
+            where = (root, "trunk") if int(layer) <= 3 else (root,)
+            return where + (name,) + inner
+        if sub == "conv2":  # fnet's 1x1 output projection
+            return (root, "conv2") + tuple(parts[2:])
+        m = re.fullmatch(r"outputs(08|16|32)", sub)
+        if m:
+            res, h = m.group(1), parts[2]
+            if res == "32":  # bare Conv2d, no Sequential
+                return (root, f"outputs32_{h}_conv") + tuple(parts[3:])
+            if parts[3] == "0":  # Sequential[0] = ResidualBlock
+                inner = _translate_residual(parts[4:])
+                if inner is None:
+                    return None
+                return (root, f"outputs{res}_{h}_res") + inner
+            return (root, f"outputs{res}_{h}_conv") + tuple(parts[4:])
+        raise KeyError(f"unknown {root} member: {key}")
+
+    if root == "update_block":
+        sub = parts[1]
+        if sub in ("encoder", "flow_head") or re.fullmatch(r"gru(08|16|32)",
+                                                          sub):
+            return ("update_block", sub) + tuple(parts[2:])
+        if sub == "mask":
+            which = {"0": "mask_conv1", "2": "mask_conv2"}[parts[2]]
+            return ("update_block", which) + tuple(parts[3:])
+        raise KeyError(f"unknown update_block member: {key}")
+
+    if root == "context_zqr_convs":
+        return (f"context_zqr_conv{parts[1]}",) + tuple(parts[2:])
+
+    if root == "conv2":  # shared-backbone head Sequential
+        if parts[1] == "0":
+            inner = _translate_residual(parts[2:])
+            if inner is None:
+                return None
+            return ("conv2_res",) + inner
+        return ("conv2_out",) + tuple(parts[2:])
+
+    raise KeyError(f"unknown root module: {key}")
+
+
+def import_torch_checkpoint(path: str,
+                            config: Optional[RaftStereoConfig] = None,
+                            **config_overrides
+                            ) -> Tuple[RaftStereoConfig, Dict[str, Any]]:
+    """Load a reference ``.pth`` → ``(config, variables)``.
+
+    ``variables`` has ``params`` (+ ``batch_stats`` for batch-norm nets) and
+    matches ``RAFTStereo(config)`` exactly — validated leaf-by-leaf.
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    state = _load_state_dict(path)
+    if config is None:
+        config = infer_config_from_state_dict(state, **config_overrides)
+
+    # Target template (shapes only, abstract init — no FLOPs)
+    model = RAFTStereo(config)
+    dummy = jnp.zeros((1, 64, 96, 3), jnp.float32)
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True))
+    flat_template = flatten_dict(template)
+
+    flat = {}
+    skipped = []
+    for key, value in state.items():
+        if key.endswith(_SKIP_SUFFIXES):
+            continue
+        module_path = _translate(key)
+        if module_path is None:
+            continue
+        leaf = module_path[-1]
+        module_path = module_path[:-1]
+        if leaf == "weight":
+            if value.ndim == 4:  # conv OIHW → HWIO
+                entry = ("params",) + module_path + ("kernel",)
+                value = value.transpose(2, 3, 1, 0)
+            else:  # norm affine
+                entry = ("params",) + module_path + ("scale",)
+        elif leaf == "bias":
+            entry = ("params",) + module_path + ("bias",)
+        elif leaf == "running_mean":
+            entry = ("batch_stats",) + module_path + ("mean",)
+        elif leaf == "running_var":
+            entry = ("batch_stats",) + module_path + ("var",)
+        else:
+            raise KeyError(f"unknown leaf {leaf!r} in {key}")
+
+        if entry not in flat_template:
+            # reference allocates unused modules (gru32/layer5/outputs32 at
+            # n_gru_layers<3; fnet alongside shared_backbone never happens)
+            skipped.append(key)
+            continue
+        expect = flat_template[entry].shape
+        if tuple(value.shape) != tuple(expect):
+            raise ValueError(
+                f"{key}: shape {value.shape} != expected {expect} at "
+                f"{'/'.join(entry)}")
+        flat[entry] = jnp.asarray(value)
+
+    missing = sorted(set(flat_template) - set(flat))
+    if missing:
+        raise ValueError(
+            "torch checkpoint left target leaves unfilled: "
+            + ", ".join("/".join(m) for m in missing[:10])
+            + (f" … +{len(missing) - 10} more" if len(missing) > 10 else ""))
+    if skipped:
+        log.info("skipped %d unused reference params (e.g. %s)",
+                 len(skipped), skipped[0])
+
+    variables = unflatten_dict(flat)
+    return config, {k: dict(v) if not isinstance(v, dict) else v
+                    for k, v in variables.items()}
