@@ -1,0 +1,66 @@
+"""Shared CLI plumbing.
+
+Unlike the reference — which duplicates every architecture flag across
+train/eval/demo and silently mis-loads checkpoints when they drift
+(reference: train_stereo.py:233-240, evaluate_stereo.py:193-208,
+demo.py:54-72) — our checkpoints are self-describing: orbax exports carry
+``config.json`` and reference ``.pth`` files get their architecture inferred
+from the weights.  CLI architecture flags exist only as overrides for the
+few non-inferable runtime switches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from raft_stereo_tpu.config import RaftStereoConfig
+
+
+def setup_logging():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)-8s [%(name)s] %(message)s")
+
+
+def add_arch_overrides(parser: argparse.ArgumentParser):
+    """Runtime switches not recorded in weights."""
+    parser.add_argument("--corr_implementation", default=None,
+                        choices=["reg", "alt", "reg_cuda", "alt_cuda",
+                                 "reg_fused"],
+                        help="correlation backend override")
+    parser.add_argument("--slow_fast_gru", action="store_true",
+                        help="extra coarse-GRU updates per iteration")
+    parser.add_argument("--mixed_precision", action="store_true",
+                        help="bf16 compute")
+
+
+def arch_overrides(args) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if args.corr_implementation:
+        out["corr_backend"] = args.corr_implementation
+    if args.slow_fast_gru:
+        out["slow_fast_gru"] = True
+    if args.mixed_precision:
+        out["mixed_precision"] = True
+    return out
+
+
+def load_any_checkpoint(path: str, **overrides
+                        ) -> Tuple[RaftStereoConfig, Dict[str, Any]]:
+    """Load ``(config, variables)`` from a reference ``.pth`` file or one of
+    our orbax checkpoint directories."""
+    if path.endswith(".pth"):
+        from raft_stereo_tpu.io.torch_import import import_torch_checkpoint
+        return import_torch_checkpoint(path, **overrides)
+
+    from raft_stereo_tpu.training import checkpoint as ckpt
+    cfg, tree = ckpt.load_checkpoint(path)
+    if overrides:
+        cfg = RaftStereoConfig.from_dict({**cfg.to_dict(), **overrides})
+    variables = {"params": tree["params"]}
+    if tree.get("batch_stats"):
+        variables["batch_stats"] = tree["batch_stats"]
+    return cfg, variables

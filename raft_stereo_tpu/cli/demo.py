@@ -1,0 +1,95 @@
+"""Inference demo: stereo pairs → disparity images (reference: demo.py).
+
+    python -m raft_stereo_tpu.cli.demo --restore_ckpt models/raftstereo-eth3d.pth \\
+        -l 'datasets/ETH3D/two_view_training/*/im0.png' \\
+        -r 'datasets/ETH3D/two_view_training/*/im1.png'
+
+Saves ``<name>.png`` jet-colormapped disparity (and ``.npy`` with
+``--save_numpy``) into ``--output_directory``, like the reference
+(demo.py:46-50).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+
+import numpy as np
+
+from raft_stereo_tpu.cli import common
+
+log = logging.getLogger(__name__)
+
+
+def jet_colormap(x: np.ndarray) -> np.ndarray:
+    """Normalized [0,1] → uint8 RGB using matplotlib's jet (with a NumPy
+    fallback so the demo runs without matplotlib)."""
+    try:
+        from matplotlib import cm
+        return (cm.jet(np.clip(x, 0, 1))[..., :3] * 255).astype(np.uint8)
+    except ImportError:  # piecewise-linear jet approximation
+        x = np.clip(x, 0, 1)
+        r = np.clip(1.5 - np.abs(4 * x - 3), 0, 1)
+        g = np.clip(1.5 - np.abs(4 * x - 2), 0, 1)
+        b = np.clip(1.5 - np.abs(4 * x - 1), 0, 1)
+        return (np.stack([r, g, b], -1) * 255).astype(np.uint8)
+
+
+def run_demo(args) -> int:
+    from PIL import Image
+
+    from raft_stereo_tpu.data.frame_utils import read_image
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = common.load_any_checkpoint(
+        args.restore_ckpt, **common.arch_overrides(args))
+    runner = InferenceRunner(cfg, variables, iters=args.valid_iters)
+
+    out_dir = args.output_directory
+    os.makedirs(out_dir, exist_ok=True)
+    left_images = sorted(glob.glob(args.left_imgs, recursive=True))
+    right_images = sorted(glob.glob(args.right_imgs, recursive=True))
+    if len(left_images) != len(right_images) or not left_images:
+        raise SystemExit(
+            f"found {len(left_images)} left / {len(right_images)} right "
+            "images — globs must match pairwise")
+    log.info("found %d image pairs; writing to %s", len(left_images), out_dir)
+
+    for left_path, right_path in zip(left_images, right_images):
+        disp = runner.disparity(read_image(left_path),
+                                read_image(right_path))
+        stem = os.path.splitext(os.path.basename(left_path))[0]
+        if args.save_numpy:
+            np.save(os.path.join(out_dir, f"{stem}.npy"), disp)
+        vis = jet_colormap(disp / max(float(disp.max()), 1e-6))
+        Image.fromarray(vis).save(os.path.join(out_dir,
+                                               f"{stem}-disparity.png"))
+        log.info("%s: disparity range [%.2f, %.2f]", stem, disp.min(),
+                 disp.max())
+    return len(left_images)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", required=True,
+                   help=".pth or orbax checkpoint directory")
+    p.add_argument("-l", "--left_imgs", required=True,
+                   help="glob for left (im0) images")
+    p.add_argument("-r", "--right_imgs", required=True,
+                   help="glob for right (im1) images")
+    p.add_argument("--output_directory", default="demo_output")
+    p.add_argument("--save_numpy", action="store_true")
+    p.add_argument("--valid_iters", type=int, default=32)
+    common.add_arch_overrides(p)
+    return p
+
+
+def main(argv=None):
+    common.setup_logging()
+    run_demo(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

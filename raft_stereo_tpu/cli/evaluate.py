@@ -1,0 +1,77 @@
+"""Benchmark evaluation CLI (reference: evaluate_stereo.py:192-242).
+
+    python -m raft_stereo_tpu.cli.evaluate --restore_ckpt models/raftstereo-eth3d.pth \\
+        --dataset eth3d
+
+Datasets: eth3d | kitti | things | middlebury_F | middlebury_H | middlebury_Q.
+KITTI additionally reports the FPS protocol (warmup-discarded).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+from raft_stereo_tpu.cli import common
+
+log = logging.getLogger(__name__)
+
+
+def run_eval(args) -> dict:
+    from raft_stereo_tpu.eval import (InferenceRunner, validate_eth3d,
+                                      validate_kitti, validate_middlebury,
+                                      validate_things)
+
+    overrides = common.arch_overrides(args)
+    # mirror the reference: bf16 lookup is safe only for the fused corr
+    # backend (evaluate_stereo.py:227-230)
+    cfg, variables = common.load_any_checkpoint(args.restore_ckpt, **overrides)
+    log.info("model config: %s", cfg.to_dict())
+    runner = InferenceRunner(cfg, variables, iters=args.valid_iters)
+
+    root = args.data_root
+    if args.dataset == "eth3d":
+        return validate_eth3d(runner, root=f"{root}/ETH3D",
+                              max_images=args.max_images)
+    if args.dataset == "kitti":
+        return validate_kitti(runner, root=f"{root}/KITTI",
+                              max_images=args.max_images)
+    if args.dataset == "things":
+        return validate_things(runner, root=root, max_images=args.max_images)
+    if args.dataset.startswith("middlebury_"):
+        return validate_middlebury(runner, root=f"{root}/Middlebury",
+                                   split=args.dataset.removeprefix(
+                                       "middlebury_"),
+                                   max_images=args.max_images)
+    raise SystemExit(f"unknown dataset {args.dataset!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", required=True)
+    p.add_argument("--dataset", required=True,
+                   choices=["eth3d", "kitti", "things", "middlebury_F",
+                            "middlebury_H", "middlebury_Q"])
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--valid_iters", type=int, default=32,
+                   help="GRU iterations (reference: --valid_iters)")
+    p.add_argument("--max_images", type=int, default=None,
+                   help="evaluate only the first N images (smoke runs)")
+    p.add_argument("--json", action="store_true",
+                   help="print results as one JSON line")
+    common.add_arch_overrides(p)
+    return p
+
+
+def main(argv=None):
+    common.setup_logging()
+    args = build_parser().parse_args(argv)
+    results = run_eval(args)
+    if args.json:
+        print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Training CLI (reference: train_stereo.py:214-258).
+
+    python -m raft_stereo_tpu.cli.train --name raft-stereo \\
+        --train_datasets sceneflow --batch_size 8 --train_iters 22
+
+Architecture and schedule flags mirror the reference's names; everything is
+captured into the two config dataclasses and saved with every checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from raft_stereo_tpu.cli import common
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+
+log = logging.getLogger(__name__)
+
+
+def configs_from_args(args):
+    model_cfg = RaftStereoConfig(
+        hidden_dims=tuple(args.hidden_dims),
+        n_gru_layers=args.n_gru_layers,
+        n_downsample=args.n_downsample,
+        corr_levels=args.corr_levels,
+        corr_radius=args.corr_radius,
+        corr_backend=args.corr_implementation or "reg",
+        shared_backbone=args.shared_backbone,
+        slow_fast_gru=args.slow_fast_gru,
+        mixed_precision=args.mixed_precision,
+    )
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size,
+        train_iters=args.train_iters,
+        valid_iters=args.valid_iters,
+        lr=args.lr,
+        num_steps=args.num_steps,
+        wdecay=args.wdecay,
+        image_size=tuple(args.image_size),
+        train_datasets=tuple(args.train_datasets),
+        img_gamma=tuple(args.img_gamma) if args.img_gamma else None,
+        saturation_range=(tuple(args.saturation_range)
+                          if args.saturation_range else None),
+        do_flip=args.do_flip,
+        spatial_scale=tuple(args.spatial_scale),
+        noyjitter=args.noyjitter,
+        seed=args.seed,
+    )
+    return model_cfg, train_cfg
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--name", default="raft-stereo")
+    p.add_argument("--restore_ckpt", default=None,
+                   help=".pth (warm start) or orbax dir (exact resume)")
+    p.add_argument("--data_root", default="datasets")
+    p.add_argument("--checkpoint_dir", default="checkpoints")
+    p.add_argument("--log_dir", default="runs")
+    # schedule (reference: train_stereo.py:221-227)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--train_datasets", nargs="+", default=["sceneflow"])
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--num_steps", type=int, default=200_000)
+    p.add_argument("--image_size", type=int, nargs=2, default=[320, 720])
+    p.add_argument("--train_iters", type=int, default=16)
+    p.add_argument("--valid_iters", type=int, default=32)
+    p.add_argument("--wdecay", type=float, default=1e-5)
+    p.add_argument("--seed", type=int, default=1234)
+    # architecture (reference: train_stereo.py:233-240)
+    p.add_argument("--hidden_dims", type=int, nargs=3, default=[128, 128, 128])
+    p.add_argument("--n_gru_layers", type=int, default=3)
+    p.add_argument("--n_downsample", type=int, default=2)
+    p.add_argument("--corr_levels", type=int, default=4)
+    p.add_argument("--corr_radius", type=int, default=4)
+    p.add_argument("--shared_backbone", action="store_true")
+    # augmentation (reference: train_stereo.py:243-247)
+    p.add_argument("--img_gamma", type=float, nargs="+", default=None)
+    p.add_argument("--saturation_range", type=float, nargs=2, default=None)
+    p.add_argument("--do_flip", default=None, choices=["h", "v"])
+    p.add_argument("--spatial_scale", type=float, nargs=2,
+                   default=[-0.2, 0.4])
+    p.add_argument("--noyjitter", action="store_true")
+    common.add_arch_overrides(p)
+    return p
+
+
+def main(argv=None):
+    common.setup_logging()
+    args = build_parser().parse_args(argv)
+    model_cfg, train_cfg = configs_from_args(args)
+    log.info("model config: %s", model_cfg.to_dict())
+    log.info("train config: %s", train_cfg.to_dict())
+
+    from raft_stereo_tpu.training.train_loop import train
+    return train(model_cfg, train_cfg, name=args.name,
+                 data_root=args.data_root,
+                 checkpoint_dir=args.checkpoint_dir,
+                 restore=args.restore_ckpt, log_dir=args.log_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Training metrics logger: running-mean console prints + TensorBoard.
+
+Reference: train_stereo.py:82-129 — running means flushed every
+``SUM_FREQ=100`` steps to console and a ``runs/`` SummaryWriter, per-step
+``live_loss``/``learning_rate`` scalars, ``write_dict`` for validation
+results.  TensorBoard is optional here (gated import) so headless test
+environments need no tensorboard install.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+SUM_FREQ = 100
+
+
+class Logger:
+    def __init__(self, log_dir: str = "runs", total_steps: int = 0,
+                 enable_tensorboard: bool = True):
+        self.total_steps = total_steps
+        self.running: Dict[str, float] = {}
+        self.writer = None
+        if enable_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.writer = SummaryWriter(log_dir=log_dir)
+            except ImportError:
+                log.warning("tensorboard unavailable; console logging only")
+
+    def _flush(self, lr: float):
+        means = {k: v / SUM_FREQ for k, v in self.running.items()}
+        msg = ", ".join(f"{k} {v:.4f}" for k, v in sorted(means.items()))
+        log.info("step %d, lr %.7f: %s", self.total_steps, lr, msg)
+        if self.writer is not None:
+            for k, v in means.items():
+                self.writer.add_scalar(k, v, self.total_steps)
+        self.running = {}
+
+    def push(self, metrics: Dict[str, float], lr: float = 0.0):
+        """Accumulate one step's metrics; flush every SUM_FREQ steps."""
+        self.total_steps += 1
+        for k, v in metrics.items():
+            self.running[k] = self.running.get(k, 0.0) + float(v)
+        if self.writer is not None:
+            self.writer.add_scalar("live_loss", float(metrics.get("loss", 0)),
+                                   self.total_steps)
+            self.writer.add_scalar("learning_rate", lr, self.total_steps)
+        if self.total_steps % SUM_FREQ == SUM_FREQ - 1:
+            self._flush(lr)
+
+    def write_dict(self, results: Dict[str, float]):
+        """Log validation results (reference: train_stereo.py:121-126)."""
+        log.info("validation @ step %d: %s", self.total_steps, results)
+        if self.writer is not None:
+            for k, v in results.items():
+                self.writer.add_scalar(k, float(v), self.total_steps)
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
