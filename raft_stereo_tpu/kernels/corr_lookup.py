@@ -1,24 +1,164 @@
 """Pallas TPU kernel: fused correlation-pyramid window lookup.
 
-TPU-native replacement for the reference's CUDA extension
-(reference: sampler/sampler_kernel.cu — one thread per output pixel streaming
-2r+2 taps along the disparity axis; hand-written scatter backward).
+TPU-native replacement for the reference's CUDA extension (reference:
+sampler/sampler.cpp + sampler/sampler_kernel.cu): sample a (2r+1)-tap window
+of the 1-D correlation volume at fractional disparity positions, with linear
+interpolation and zero padding, in the volume's own dtype (bf16-safe — the
+whole point of the reference's fp16 CUDA path, sampler_kernel.cu:126).
 
-Placeholder in this milestone: the XLA lookup in models/corr.py is the live
-path; the fused kernel lands with the performance phase (SURVEY.md §7 step 9).
+Design: gathers are hostile to the TPU vector unit, so the kernel never
+gathers.  For tap k the interpolation weight of volume bin x at center c is
+the hat function  max(0, 1 - |x - (c + k - r)|)  — nonzero for at most the
+two bins the reference's CUDA kernel reads (sampler_kernel.cu:46-59).  Each
+(rows × W1-block) tile computes, per tap, an elementwise weight field over
+the whole W2 axis and a multiply-reduce — pure VPU work on contiguous lanes,
+O(K·W2) per pixel instead of a 2-bin gather, which wins on TPU because it
+vectorizes and the volume tile is already in VMEM.
+
+Backward mirrors the reference's hand-written scatter kernel
+(sampler_kernel.cu:64-105) but needs no atomics: dV[x] = Σ_k g_k·hat_k(x) is
+again an elementwise multiply-accumulate.  Like the reference's
+``CorrSampler.backward`` (core/corr.py:24-29), no coordinate gradient is
+produced — RAFT-Stereo detaches coords before every lookup
+(core/raft_stereo.py:109).
 """
 
 from __future__ import annotations
 
-from typing import List
+import functools
+from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_BLK = 8       # (batch·H) rows per tile
+W1_BLK = 128      # output pixels per tile (lane-aligned)
+
+_interpret_override: Optional[bool] = None
 
 
 def fused_lookup_available() -> bool:
-    return False
+    if _interpret_override is not None:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _interpret() -> bool:
+    return bool(_interpret_override) if _interpret_override is not None \
+        else False
+
+
+# ------------------------------------------------------------------ kernels
+def _fwd_kernel(vol_ref, coords_ref, out_ref, *, radius: int, scale: float):
+    """One (ROW_BLK, W1_BLK) tile: volume (R, W1B, W2) + centers (R, W1B)
+    → window samples (R, W1B, K)."""
+    w2 = vol_ref.shape[-1]
+    vol = vol_ref[:].astype(jnp.float32)              # (R, W1B, W2)
+    centers = coords_ref[:].astype(jnp.float32) * scale   # (R, W1B)
+    # Mosaic only supports integer iota; cast to float after
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)                  # (R, W1B)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        out_ref[:, :, k] = jnp.sum(vol * w, axis=-1).astype(out_ref.dtype)
+
+
+def _bwd_kernel(coords_ref, g_ref, dvol_ref, *, radius: int, scale: float):
+    """Tile transpose of the forward: g (R, W1B, K) → dV (R, W1B, W2)."""
+    centers = coords_ref[:].astype(jnp.float32) * scale
+    g = g_ref[:].astype(jnp.float32)
+    w2 = dvol_ref.shape[-1]
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    acc = jnp.zeros(dvol_ref.shape, jnp.float32)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        acc = acc + g[:, :, k][..., None] * w
+    dvol_ref[:] = acc.astype(dvol_ref.dtype)
+
+
+# ------------------------------------------------------------------- launch
+def _launch_fwd(vol: jnp.ndarray, coords: jnp.ndarray, radius: int,
+                scale: float) -> jnp.ndarray:
+    rows, w1, w2 = vol.shape
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, radius=radius, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, w2), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k), vol.dtype),
+        interpret=_interpret(),
+    )(vol, coords)
+
+
+def _launch_bwd(coords: jnp.ndarray, g: jnp.ndarray, w2: int, radius: int,
+                scale: float, dtype) -> jnp.ndarray:
+    rows, w1 = coords.shape
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, radius=radius, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, w2), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, w2), dtype),
+        interpret=_interpret(),
+    )(coords, g)
+
+
+# ----------------------------------------------------------- level sampling
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _sample_level(vol, coords, radius: int, scale: float):
+    """(B,H,W1,W2) volume + (B,H,W1) centers → (B,H,W1,2r+1) window."""
+    b, h, w1, w2 = vol.shape
+    out = _launch_fwd(vol.reshape(b * h, w1, w2),
+                      coords.reshape(b * h, w1), radius, scale)
+    return out.reshape(b, h, w1, -1)
+
+
+def _sample_level_fwd(vol, coords, radius, scale):
+    # vol rides along only for its STATIC shape/dtype; its values are unused
+    # in the backward, so XLA dead-code-eliminates the residual.
+    return _sample_level(vol, coords, radius, scale), (vol, coords)
+
+
+def _sample_level_bwd(radius, scale, residuals, g):
+    vol, coords = residuals
+    b, h, w1, w2 = vol.shape
+    dvol = _launch_bwd(coords.reshape(b * h, w1),
+                       g.reshape(b * h, w1, -1), w2, radius, scale,
+                       vol.dtype)
+    # No coords grad: RAFT detaches coords before every lookup, and the
+    # reference kernel's backward also only produces volume gradients.
+    return dvol.reshape(vol.shape), jnp.zeros_like(coords)
+
+
+_sample_level.defvjp(_sample_level_fwd, _sample_level_bwd)
 
 
 def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
                          radius: int) -> jnp.ndarray:
-    raise NotImplementedError("Pallas fused lookup lands in the perf phase")
+    """Fused window lookup at every pyramid level, concat level-major —
+    drop-in replacement for ``lookup_pyramid_xla`` (models/corr.py)."""
+    outs = [_sample_level(vol, coords, radius, 1.0 / (2 ** i))
+            for i, vol in enumerate(pyramid)]
+    return jnp.concatenate(outs, axis=-1)
