@@ -40,7 +40,7 @@ _interpret_override: Optional[bool] = None
 
 
 def fused_lookup_available() -> bool:
-    if _interpret_override is not None:
+    if _interpret_override:  # interpret mode works on any backend
         return True
     try:
         return jax.default_backend() == "tpu"
@@ -49,8 +49,7 @@ def fused_lookup_available() -> bool:
 
 
 def _interpret() -> bool:
-    return bool(_interpret_override) if _interpret_override is not None \
-        else False
+    return bool(_interpret_override)
 
 
 # ------------------------------------------------------------------ kernels
