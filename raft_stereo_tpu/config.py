@@ -72,6 +72,12 @@ class RaftStereoConfig:
             raise ValueError(
                 "n_gru_layers must be in [1, min(len(hidden_dims), 3)] — the "
                 "update block implements at most 3 GRU levels")
+        if self.corr_w2_shards > 1 and self.corr_backend != "reg":
+            raise ValueError(
+                f"corr_w2_shards={self.corr_w2_shards} is the sharded form of "
+                f"the 'reg' volume and is incompatible with "
+                f"corr_backend={self.corr_backend!r} (alt builds no volume; "
+                f"reg_fused's Pallas lookup is per-chip) — use 'reg'")
 
     # ------------------------------------------------------------------ sizes
     @property

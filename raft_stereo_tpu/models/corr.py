@@ -162,5 +162,19 @@ _BACKENDS = {
 
 def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
                  fmap2: jnp.ndarray) -> CorrFn:
-    """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100)."""
+    """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100).
+
+    ``corr_w2_shards > 1`` routes to the disparity-axis-sharded volume
+    (parallel/corr_sharded.py) — the sharded form of ``reg`` (config
+    validation rejects other backends); activate a mesh with
+    ``corr_sharding(mesh)`` during tracing first."""
+    if cfg.corr_w2_shards > 1:
+        from raft_stereo_tpu.parallel.corr_sharded import (
+            active_corr_mesh, make_corr_fn_w2_sharded)
+        mesh = active_corr_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                f"corr_w2_shards={cfg.corr_w2_shards} needs an active mesh: "
+                "trace the model under parallel.corr_sharded.corr_sharding(mesh)")
+        return make_corr_fn_w2_sharded(cfg, fmap1, fmap2, mesh)
     return _BACKENDS[cfg.corr_backend](cfg, fmap1, fmap2)

@@ -1,4 +1,8 @@
+from raft_stereo_tpu.parallel.corr_sharded import (active_corr_mesh,
+                                                   corr_sharding,
+                                                   make_corr_fn_w2_sharded)
 from raft_stereo_tpu.parallel.mesh import (DATA_AXIS, CORR_AXIS, make_mesh,
                                            shard_batch, replicate)
 
-__all__ = ["DATA_AXIS", "CORR_AXIS", "make_mesh", "shard_batch", "replicate"]
+__all__ = ["DATA_AXIS", "CORR_AXIS", "make_mesh", "shard_batch", "replicate",
+           "corr_sharding", "active_corr_mesh", "make_corr_fn_w2_sharded"]

@@ -1,0 +1,160 @@
+"""Disparity-axis (W2) sharded correlation — the "long-context" path.
+
+The reg correlation volume is O(B·H·W1·W2) memory; at full Middlebury-F
+resolution it dominates HBM.  The reference's answer is to avoid the volume
+entirely ("alt", reference: core/corr.py:64-107) or downsample more
+(reference: train_stereo.py:237).  A TPU pod offers a third axis the
+reference never had: shard the disparity-*search* dimension W2 across chips
+(SURVEY.md §5 — the stereo analog of sequence parallelism).
+
+Design (SPMD via ``shard_map`` over the ``corr`` mesh axis):
+
+* **Build** — each chip holds a W-slice of the right feature map and computes
+  its (B, H, W1, W2/n) slice of the volume as a local MXU matmul; the pyramid
+  is pooled locally (shard widths are kept divisible by 2^(levels-1), so
+  2-wide stride-2 pooling never crosses a shard boundary and matches the
+  reference's global floor semantics — core/corr.py:124).  The full volume is
+  never materialized on any one chip.
+* **Lookup** — linear interpolation is a 2-tap weighted sum, so each chip
+  samples its local slice with shard-local coordinates (taps falling outside
+  the shard contribute zero, exactly the zero-padding semantics of
+  ``ops.sampler.linear_sampler_1d``) and a ``psum`` over ``corr`` assembles
+  the exact global window: every global bin is owned by exactly one shard.
+  The per-iteration collective is the small (B, H, W1, levels·(2r+1)) lookup
+  result riding ICI — never the volume.
+
+Exactness: W2 is zero-padded up to ``n_corr · 2^(levels-1)`` divisibility
+(zero right-features ⇒ zero correlation), and after every pooling step bins
+whose *global* index falls at or beyond the reference's floor-semantics level
+width are zeroed, so boundary taps read zero exactly where the reference's
+out-of-range sampling does.  ``tests/test_parallel.py`` asserts bit-level
+agreement (values and gradients) with the unsharded ``reg`` backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.corr import pool_last_axis
+from raft_stereo_tpu.ops.sampler import linear_sampler_1d
+from raft_stereo_tpu.parallel.mesh import CORR_AXIS
+
+_active_mesh: Optional[Mesh] = None
+
+
+@contextlib.contextmanager
+def corr_sharding(mesh: Mesh):
+    """Activate ``mesh`` for W2-sharded correlation within the block.
+
+    Wrap the *tracing* of any jitted function whose model config has
+    ``corr_w2_shards > 1`` (training step, eval forward, dry-run)."""
+    global _active_mesh
+    if CORR_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no {CORR_AXIS!r} axis")
+    prev, _active_mesh = _active_mesh, mesh
+    try:
+        yield mesh
+    finally:
+        _active_mesh = prev
+
+
+def active_corr_mesh() -> Optional[Mesh]:
+    return _active_mesh
+
+
+def _level_widths(w2: int, num_levels: int) -> List[int]:
+    """True (unpadded) level widths under the reference's floor pooling."""
+    widths = [w2]
+    for _ in range(num_levels - 1):
+        widths.append(widths[-1] // 2)
+    return widths
+
+
+def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
+                            fmap2: jnp.ndarray, mesh: Mesh):
+    """Sharded-volume counterpart of ``models.corr.make_corr_fn_reg``.
+
+    Returns a ``CorrFn``; call under ``corr_sharding(mesh)`` during tracing.
+    """
+    n = cfg.corr_w2_shards
+    axis_size = mesh.shape[CORR_AXIS]
+    if axis_size != n:
+        raise ValueError(
+            f"config asks for corr_w2_shards={n} but mesh {CORR_AXIS!r} axis "
+            f"has {axis_size} devices")
+    num_levels = cfg.corr_levels
+    radius = cfg.corr_radius
+
+    fmap1 = fmap1.astype(jnp.float32)
+    fmap2 = fmap2.astype(jnp.float32)
+    d = fmap1.shape[-1]
+    w2 = fmap2.shape[2]
+    widths = _level_widths(w2, num_levels)
+
+    # Pad W2 so every pooled level splits evenly across shards.
+    quantum = n * 2 ** (num_levels - 1)
+    w2p = -(-w2 // quantum) * quantum
+    if w2p != w2:
+        fmap2 = jnp.pad(fmap2, ((0, 0), (0, 0), (0, w2p - w2), (0, 0)))
+
+    def build_local(f1: jnp.ndarray, f2_local: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, ...]:
+        vol = jnp.einsum("bhwd,bhvd->bhwv", f1, f2_local,
+                         precision=lax.Precision.HIGHEST) / math.sqrt(d)
+        shard = lax.axis_index(CORR_AXIS)
+        pyramid = []
+        for level in range(num_levels):
+            if level:
+                # Shard widths stay even at every level (padding quantum), so
+                # local pooling equals the reference's global floor pooling.
+                vol = pool_last_axis(vol)
+            lw = vol.shape[-1]
+            # Zero bins at/after the reference's floor-semantics level width
+            # so boundary taps read zero exactly like out-of-range sampling.
+            global_bin = shard * lw + jnp.arange(lw)
+            vol = jnp.where(global_bin < widths[level], vol, 0.0)
+            pyramid.append(vol)
+        return tuple(pyramid)
+
+    # Manual only over ``corr``; the batch axis stays automatic so the outer
+    # jit's data-parallel sharding (or a batch of 1 at init) passes through.
+    pyramid = jax.shard_map(
+        build_local, mesh=mesh, axis_names={CORR_AXIS},
+        in_specs=(P(), P(None, None, CORR_AXIS, None)),
+        out_specs=tuple(P(None, None, None, CORR_AXIS)
+                        for _ in range(num_levels)),
+    )(fmap1, fmap2)
+
+    dx = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+
+    def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
+                     ) -> jnp.ndarray:
+        shard = lax.axis_index(CORR_AXIS)
+        outs = []
+        for level, vol in enumerate(pyr):
+            offset = (shard * vol.shape[-1]).astype(coords.dtype)
+            taps = coords[..., None] / (2 ** level) + dx - offset
+            outs.append(linear_sampler_1d(vol, taps))
+        # Each global bin is owned by exactly one shard; out-of-shard taps
+        # contributed zero, so the sum IS the global interpolated window.
+        return lax.psum(jnp.concatenate(outs, axis=-1), CORR_AXIS)
+
+    lookup = jax.shard_map(
+        lookup_local, mesh=mesh, axis_names={CORR_AXIS},
+        in_specs=(tuple(P(None, None, None, CORR_AXIS)
+                        for _ in range(num_levels)), P()),
+        out_specs=P(),
+    )
+
+    def corr_fn(coords: jnp.ndarray) -> jnp.ndarray:
+        return lookup(pyramid, coords.astype(jnp.float32))
+
+    return corr_fn

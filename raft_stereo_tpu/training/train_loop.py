@@ -13,6 +13,7 @@ async dispatch.  Improvements over the reference, by design:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -24,6 +25,7 @@ import numpy as np
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
 from raft_stereo_tpu.data.datasets import build_training_mixture
 from raft_stereo_tpu.data.loader import StereoLoader
+from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
 from raft_stereo_tpu.training import checkpoint as ckpt
 from raft_stereo_tpu.training.logger import Logger
@@ -53,13 +55,31 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     ``loader`` overrides dataset construction (used by tests).
     """
     devices = jax.devices()
-    n_data = train_cfg.data_parallel or len(devices)
+    n_corr = model_cfg.corr_w2_shards
+    if n_corr > 1 and not use_mesh:
+        raise ValueError("corr_w2_shards > 1 requires use_mesh=True")
+    n_data = train_cfg.data_parallel or len(devices) // n_corr
     if train_cfg.batch_size % n_data:
         raise ValueError(f"batch_size={train_cfg.batch_size} not divisible "
                          f"by {n_data} data-parallel devices")
-    mesh = make_mesh(n_data=n_data, devices=devices[:n_data]) if use_mesh \
-        else None
+    mesh = make_mesh(n_data=n_data, n_corr=n_corr,
+                     devices=devices[:n_data * n_corr]) if use_mesh else None
 
+    # W2-sharded correlation needs the mesh active whenever the model is
+    # traced (init, warm-start re-init, and the jitted step), so hold the
+    # context for the whole run.
+    with contextlib.ExitStack() as ctx:
+        if n_corr > 1:
+            ctx.enter_context(corr_sharding(mesh))
+        return _train_impl(model_cfg, train_cfg, name, data_root,
+                           checkpoint_dir, restore, log_dir, validate_fn,
+                           loader, mesh)
+
+
+def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
+                name: str, data_root: str, checkpoint_dir: str,
+                restore: Optional[str], log_dir: str, validate_fn,
+                loader: Optional[StereoLoader], mesh) -> TrainState:
     h, w = train_cfg.image_size
     init_shape = (1, h, w, 3)
     rng = jax.random.PRNGKey(train_cfg.seed)
