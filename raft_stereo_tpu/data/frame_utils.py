@@ -28,13 +28,27 @@ except ImportError:  # pragma: no cover
 
 from PIL import Image
 
+from raft_stereo_tpu import native
+
 FLO_MAGIC = 202021.25
 
 
 # ------------------------------------------------------------------ images
 def read_image(path: str) -> np.ndarray:
-    """Read an image as (H, W, 3) uint8; grayscale is replicated to 3ch."""
+    """Read an image as (H, W, 3) uint8; grayscale is replicated to 3ch.
+
+    PNGs go through the native decoder when built (GIL-free in loader
+    threads); other formats and fallback use PIL."""
+    if native.available() and path.lower().endswith(".png"):
+        try:
+            return native.read_png_rgb8(path)
+        except ValueError:
+            pass  # odd sub-format — let PIL try
     img = np.asarray(Image.open(path))
+    if img.dtype != np.uint8 and np.issubdtype(img.dtype, np.integer):
+        # 16-bit sources: keep the high byte, matching the native decoder's
+        # png_set_strip_16 (astype alone would keep the LOW byte).
+        img = (img.astype(np.uint32) >> 8).astype(np.uint8)
     if img.ndim == 2:
         img = np.repeat(img[..., None], 3, axis=-1)
     return img[..., :3].astype(np.uint8)
@@ -43,7 +57,17 @@ def read_image(path: str) -> np.ndarray:
 # --------------------------------------------------------------------- PFM
 def read_pfm(path: str) -> np.ndarray:
     """Portable Float Map: 'Pf' (1ch) / 'PF' (3ch), rows stored bottom-up,
-    scale sign encodes endianness."""
+    scale sign encodes endianness.  Native decoder when built; the pure-
+    Python path below is the fallback and the semantics reference."""
+    if native.available():
+        try:
+            return native.read_pfm(path)
+        except ValueError:
+            pass
+    return _read_pfm_py(path)
+
+
+def _read_pfm_py(path: str) -> np.ndarray:
     with open(path, "rb") as f:
         header = f.readline().rstrip()
         if header == b"PF":
@@ -98,6 +122,12 @@ def write_flo(path: str, flow: np.ndarray) -> None:
 def read_disp_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
     """KITTI 16-bit PNG: disparity*256, 0 = invalid
     (reference: core/utils/frame_utils.py:124-127)."""
+    if native.available():
+        try:
+            disp = native.read_png_gray16(path).astype(np.float32) / 256.0
+            return disp, disp > 0.0
+        except ValueError:
+            pass
     if cv2 is not None:
         raw = cv2.imread(path, cv2.IMREAD_ANYDEPTH)
     else:  # pragma: no cover

@@ -34,7 +34,6 @@ agreement (values and gradients) with the unsharded ``reg`` backend.
 from __future__ import annotations
 
 import contextlib
-import math
 from typing import List, Optional, Tuple
 
 import jax
@@ -43,7 +42,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_stereo_tpu.config import RaftStereoConfig
-from raft_stereo_tpu.models.corr import pool_last_axis
+from raft_stereo_tpu.models.corr import (_window_coords, build_corr_volume,
+                                         pool_last_axis)
 from raft_stereo_tpu.ops.sampler import linear_sampler_1d
 from raft_stereo_tpu.parallel.mesh import CORR_AXIS
 
@@ -95,7 +95,6 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
 
     fmap1 = fmap1.astype(jnp.float32)
     fmap2 = fmap2.astype(jnp.float32)
-    d = fmap1.shape[-1]
     w2 = fmap2.shape[2]
     widths = _level_widths(w2, num_levels)
 
@@ -107,8 +106,7 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
 
     def build_local(f1: jnp.ndarray, f2_local: jnp.ndarray
                     ) -> Tuple[jnp.ndarray, ...]:
-        vol = jnp.einsum("bhwd,bhvd->bhwv", f1, f2_local,
-                         precision=lax.Precision.HIGHEST) / math.sqrt(d)
+        vol = build_corr_volume(f1, f2_local)
         shard = lax.axis_index(CORR_AXIS)
         pyramid = []
         for level in range(num_levels):
@@ -133,15 +131,13 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
                         for _ in range(num_levels)),
     )(fmap1, fmap2)
 
-    dx = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
-
     def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
                      ) -> jnp.ndarray:
         shard = lax.axis_index(CORR_AXIS)
         outs = []
         for level, vol in enumerate(pyr):
             offset = (shard * vol.shape[-1]).astype(coords.dtype)
-            taps = coords[..., None] / (2 ** level) + dx - offset
+            taps = _window_coords(coords, level, radius) - offset
             outs.append(linear_sampler_1d(vol, taps))
         # Each global bin is owned by exactly one shard; out-of-shard taps
         # contributed zero, so the sum IS the global interpolated window.

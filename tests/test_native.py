@@ -1,0 +1,118 @@
+"""Native C++ decoders (native/stereo_native.cpp via raft_stereo_tpu.native)
+vs the pure-Python readers — bit-exact agreement on synthesized files.
+
+If the toolchain/libpng is missing, ``native.available()`` is False and the
+pipeline falls back to Python; these tests then skip (the fallback itself is
+covered by test_data.py, which exercises the Python readers directly).
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_stereo_tpu import native
+from raft_stereo_tpu.data import frame_utils as fu
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native decoders not built")
+
+
+def _write_pfm_nch(path, arr, scale_line):
+    h, w = arr.shape[:2]
+    c = 3 if arr.ndim == 3 else 1
+    with open(path, "wb") as f:
+        f.write((b"PF\n" if c == 3 else b"Pf\n") + f"{w} {h}\n".encode()
+                + scale_line)
+        dt = "<f4" if b"-" in scale_line else ">f4"
+        f.write(np.flipud(arr).astype(dt).tobytes())
+
+
+@pytest.mark.parametrize("channels", [1, 3])
+@pytest.mark.parametrize("scale_line", [b"-1.0\n", b"1.0\n"])
+def test_pfm_native_matches_python(tmp_path, rng, channels, scale_line):
+    shape = (13, 17) if channels == 1 else (13, 17, 3)
+    arr = rng.standard_normal(shape).astype(np.float32)
+    p = str(tmp_path / "t.pfm")
+    _write_pfm_nch(p, arr, scale_line)
+    out_native = native.read_pfm(p)
+    out_py = fu._read_pfm_py(p)
+    np.testing.assert_array_equal(out_native, out_py)
+    np.testing.assert_array_equal(out_native, arr)
+
+
+def test_pfm_crlf_header_rejected_not_corrupted(tmp_path):
+    """A CRLF-terminated scale line must decode correctly (tolerated \\r) —
+    never silently shift the float data by one byte."""
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    p = str(tmp_path / "crlf.pfm")
+    with open(p, "wb") as f:
+        f.write(b"Pf\r\n4 3\r\n-1.0\r\n")
+        f.write(np.flipud(arr).astype("<f4").tobytes())
+    np.testing.assert_array_equal(native.read_pfm(p), arr)
+
+
+def test_pfm_space_separator_rejected(tmp_path):
+    """A non-newline header/data separator must error (fallback path), not
+    decode shifted data."""
+    arr = np.arange(4, dtype=np.float32).reshape(2, 2)
+    p = str(tmp_path / "sp.pfm")
+    with open(p, "wb") as f:
+        f.write(b"Pf\n2 2\n-1.0 ")
+        f.write(np.flipud(arr).astype("<f4").tobytes())
+    with pytest.raises(ValueError):
+        native.read_pfm(p)
+
+
+def test_pfm_rejects_garbage(tmp_path):
+    p = str(tmp_path / "bad.pfm")
+    with open(p, "wb") as f:
+        f.write(b"P6\n3 3\n255\n" + b"\x00" * 27)
+    with pytest.raises(ValueError):
+        native.read_pfm(p)
+
+
+def test_pfm_truncated(tmp_path, rng):
+    arr = rng.standard_normal((8, 8)).astype(np.float32)
+    p = str(tmp_path / "t.pfm")
+    _write_pfm_nch(p, arr, b"-1.0\n")
+    with open(p, "r+b") as f:
+        f.truncate(40)
+    with pytest.raises(ValueError):
+        native.read_pfm(p)
+
+
+@pytest.mark.parametrize("mode", ["RGB", "L", "RGBA"])
+def test_png8_native_matches_pil(tmp_path, rng, mode):
+    channels = {"RGB": 3, "L": 1, "RGBA": 4}[mode]
+    shape = (11, 9) if channels == 1 else (11, 9, channels)
+    arr = rng.integers(0, 256, shape, dtype=np.uint8)
+    p = str(tmp_path / "t.png")
+    Image.fromarray(arr, mode=mode).save(p)
+    out = native.read_png_rgb8(p)
+    ref = np.asarray(Image.open(p))
+    if ref.ndim == 2:
+        ref = np.repeat(ref[..., None], 3, axis=-1)
+    np.testing.assert_array_equal(out, ref[..., :3])
+
+
+def test_png16_kitti_roundtrip(tmp_path, rng):
+    disp = rng.uniform(0, 192, (7, 23)).astype(np.float32)
+    disp[rng.uniform(size=disp.shape) < 0.3] = 0.0  # invalid pixels
+    p = str(tmp_path / "d.png")
+    fu.write_disp_kitti(p, disp)
+    raw = native.read_png_gray16(p)
+    assert raw.dtype == np.uint16
+    got, valid = fu.read_disp_kitti(p)
+    # write_disp_kitti encodes with astype(uint16) = truncation
+    np.testing.assert_allclose(got, np.floor(disp * 256) / 256, atol=1e-6)
+    np.testing.assert_array_equal(valid, got > 0)
+    # and the native path agrees with PIL's decode of the same file
+    np.testing.assert_array_equal(raw, np.asarray(Image.open(p)))
+
+
+def test_png16_rejected_by_gray16_when_rgb(tmp_path, rng):
+    arr = rng.integers(0, 256, (5, 5, 3), dtype=np.uint8)
+    p = str(tmp_path / "t.png")
+    Image.fromarray(arr).save(p)
+    with pytest.raises(ValueError):
+        native.read_png_gray16(p)
