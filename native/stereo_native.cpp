@@ -190,6 +190,9 @@ int png_decode_rgb8(const uint8_t* buf, int64_t len, uint8_t* out) {
   PngReadState st;
   int rc = png_open(buf, len, &png, &info, &st);
   if (rc) return rc;
+  // Constructed before setjmp so a longjmp unwind path still runs its
+  // destructor on the normal function return below.
+  std::vector<png_bytep> rows;
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -4;
@@ -207,7 +210,7 @@ int png_decode_rgb8(const uint8_t* buf, int64_t len, uint8_t* out) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -5;
   }
-  std::vector<png_bytep> rows(h);
+  rows.resize(h);
   for (int64_t y = 0; y < h; ++y) rows[y] = out + y * w * 3;
   png_read_image(png, rows.data());
   png_destroy_read_struct(&png, &info, nullptr);
@@ -222,6 +225,7 @@ int png_decode_gray16(const uint8_t* buf, int64_t len, uint16_t* out) {
   PngReadState st;
   int rc = png_open(buf, len, &png, &info, &st);
   if (rc) return rc;
+  std::vector<png_bytep> rows;  // before setjmp — see png_decode_rgb8
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -4;
@@ -236,7 +240,7 @@ int png_decode_gray16(const uint8_t* buf, int64_t len, uint16_t* out) {
   png_read_update_info(png, info);
   const int64_t h = png_get_image_height(png, info);
   const int64_t w = png_get_image_width(png, info);
-  std::vector<png_bytep> rows(h);
+  rows.resize(h);
   for (int64_t y = 0; y < h; ++y)
     rows[y] = reinterpret_cast<png_bytep>(out + y * w);
   png_read_image(png, rows.data());

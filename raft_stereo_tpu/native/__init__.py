@@ -39,13 +39,22 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-o", _SO, _SRC, "-lpng", "-lz"]
+    # Compile to a per-process temp file and atomically rename: concurrent
+    # builders never expose a half-written .so (a loader that already
+    # dlopen'ed the old file keeps its mapped inode).
+    tmp = f"{_SO}.build-{os.getpid()}"
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-o", tmp, _SRC, "-lpng", "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, cwd=_SRC_DIR,
                        timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         log.info("native decoder build failed (%s); using Python readers", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -1,0 +1,122 @@
+"""Profiling & timing subsystem.
+
+The reference has no profiler — only ad-hoc wall-clock timing inside its
+KITTI validator with a 50-image warmup discard (reference:
+evaluate_stereo.py:77-82,105-107).  This module makes both first-class:
+
+* ``trace(log_dir)`` — XLA/TPU profiler traces viewable in TensorBoard or
+  Perfetto (``jax.profiler``), covering device kernels, HBM transfers, and
+  host dispatch.
+* ``annotate(name)`` — named host spans that show up inside traces; wrap
+  pipeline stages (decode, augment, device step) to see overlap.
+* ``FpsProtocol`` — the reference's FPS measurement protocol (warmup
+  discard, per-image wall time) plus a dispatch-robust *chained* variant
+  for devices behind an async tunnel, where per-call host timing lies:
+  K forwards are chained on device inside ``lax.fori_loop`` and two chain
+  lengths are differenced to cancel dispatch/round-trip overhead
+  (the method bench.py uses).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "profiles", host_tracer_level: int = 2):
+    """Capture a profiler trace into ``log_dir`` for the duration of the
+    block (TensorBoard ``profile`` plugin or Perfetto reads it)."""
+    os.makedirs(log_dir, exist_ok=True)
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, profiler_options=options)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span context; nests, shows on the host timeline in traces."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Live/peak bytes on ``device`` (default: first device); {} if the
+    backend doesn't report memory stats (e.g. CPU)."""
+    d = device or jax.devices()[0]
+    stats = getattr(d, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+@dataclass
+class FpsResult:
+    fps: float
+    mean_s: float
+    per_image_s: List[float]
+    n_timed: int
+
+    def __str__(self):
+        return f"{self.fps:.2f} fps (mean {self.mean_s * 1e3:.2f} ms over " \
+               f"{self.n_timed} images)"
+
+
+class FpsProtocol:
+    """The reference's KITTI FPS protocol (evaluate_stereo.py:77-82,105-107):
+    run ``fn`` per image, discard the first ``warmup`` timings (absorbing
+    XLA compilation the way the reference absorbs cuDNN autotune), report
+    1/mean of the rest."""
+
+    def __init__(self, warmup: int = 50):
+        self.warmup = warmup
+
+    def measure(self, fn: Callable[..., object],
+                inputs: Iterable[Tuple]) -> FpsResult:
+        times: List[float] = []
+        n = 0
+        for args in inputs:
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            elapsed = time.perf_counter() - t0
+            n += 1
+            if n > self.warmup:
+                times.append(elapsed)
+        if not times:
+            raise ValueError(
+                f"need more than warmup={self.warmup} inputs, got {n}")
+        mean = float(np.mean(times))
+        return FpsResult(fps=1.0 / mean, mean_s=mean, per_image_s=times,
+                         n_timed=len(times))
+
+
+def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
+                             k_lo: int = 3, k_hi: int = 23,
+                             repeats: int = 3) -> float:
+    """Dispatch-robust per-call device time.
+
+    ``make_chain(k)`` must return a zero-arg callable that runs ``k``
+    device-chained iterations and blocks until a scalar is ready.  The
+    difference ``(t(k_hi) - t(k_lo)) / (k_hi - k_lo)`` cancels constant
+    dispatch/round-trip overhead — use when the device sits behind an async
+    tunnel where ``block_until_ready`` returns at dispatch (see bench.py).
+    """
+    chains = {k: make_chain(k) for k in (k_lo, k_hi)}
+    for k in (k_lo, k_hi):  # compile both
+        chains[k]()
+    best = []
+    for _ in range(repeats):
+        ts = {}
+        for k in (k_lo, k_hi):
+            t0 = time.perf_counter()
+            chains[k]()
+            ts[k] = time.perf_counter() - t0
+        best.append((ts[k_hi] - ts[k_lo]) / (k_hi - k_lo))
+    return float(np.median(best))

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Fetch the evaluation datasets the validators use (Middlebury MiddEval3
+# Q/H/F + ETH3D two-view), laid out the way raft_stereo_tpu.data.datasets
+# expects (same layout as the reference — reference: download_datasets.sh).
+# KITTI-2015 and SceneFlow require manual registration and are not fetched.
+set -euo pipefail
+
+ROOT="${1:-datasets}"
+
+fetch_unzip() { # url dest_dir
+  wget -nv "$1" -P "$2"
+  (cd "$2" && unzip -o "$(basename "$1")" && rm -f "$(basename "$1")")
+}
+
+mkdir -p "$ROOT/Middlebury/MiddEval3"
+wget -nv "https://www.dropbox.com/s/fn8siy5muak3of3/official_train.txt" \
+     -P "$ROOT/Middlebury/MiddEval3/"
+for res in Q H F; do
+  fetch_unzip "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-data-${res}.zip" \
+              "$ROOT/Middlebury"
+  fetch_unzip "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-GT0-${res}.zip" \
+              "$ROOT/Middlebury"
+done
+
+mkdir -p "$ROOT/ETH3D/two_view_testing"
+wget -nv "https://www.eth3d.net/data/two_view_test.7z" \
+     -P "$ROOT/ETH3D/two_view_testing"
+(cd "$ROOT/ETH3D/two_view_testing" && 7za x -y two_view_test.7z && rm -f two_view_test.7z)
+
+echo "Datasets ready under $ROOT"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Fetch the published RAFT-Stereo pretrained checkpoints (the torch .pth zoo
+# from the reference project — reference: download_models.sh). Import them
+# with raft_stereo_tpu.io.torch_import (OIHW->HWIO, key remap) or pass the
+# .pth directly to the CLIs, which import on the fly.
+set -euo pipefail
+
+DEST="${1:-models}"
+mkdir -p "$DEST"
+cd "$DEST"
+
+echo "Fetching pretrained model zip (Dropbox mirror published by the paper authors)..."
+wget -nv "https://www.dropbox.com/s/q4312z8g5znhhkp/models.zip" -O models.zip
+unzip -o models.zip
+rm -f models.zip
+echo "Models in $DEST:"
+ls -1 *.pth
