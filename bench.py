@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import functools
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -60,16 +59,14 @@ def main():
             return acc + jnp.mean(up)
         return jax.lax.fori_loop(0, k, body, jnp.float32(0))
 
-    def timed(k):
-        t0 = time.perf_counter()
-        float(chain(variables, img1, img2, k))  # scalar fetch = full sync
-        return time.perf_counter() - t0
+    from raft_stereo_tpu.profiling import chained_seconds_per_call
 
-    for k in (K_LO, K_HI):  # compile (ref's 50-image warmup analog)
-        timed(k)
+    def make_chain(k):
+        # scalar float() fetch = full sync even behind the async tunnel
+        return lambda: float(chain(variables, img1, img2, k))
 
-    per_image = min((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO)
-                    for _ in range(REPEATS))
+    per_image = chained_seconds_per_call(make_chain, k_lo=K_LO, k_hi=K_HI,
+                                         repeats=REPEATS)
     fps = 1.0 / per_image
     print(json.dumps({
         "metric": "realtime_model_inference_fps_kitti_res",

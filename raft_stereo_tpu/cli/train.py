@@ -19,17 +19,18 @@ log = logging.getLogger(__name__)
 
 
 def configs_from_args(args):
-    model_cfg = RaftStereoConfig(
+    model_kwargs = dict(
         hidden_dims=tuple(args.hidden_dims),
         n_gru_layers=args.n_gru_layers,
         n_downsample=args.n_downsample,
         corr_levels=args.corr_levels,
         corr_radius=args.corr_radius,
-        corr_backend=args.corr_implementation or "reg",
         shared_backbone=args.shared_backbone,
-        slow_fast_gru=args.slow_fast_gru,
-        mixed_precision=args.mixed_precision,
     )
+    # Flag-gated overrides (corr backend, slow-fast, bf16): only applied when
+    # set, so the dataclass defaults govern otherwise.
+    model_kwargs.update(common.arch_overrides(args))
+    model_cfg = RaftStereoConfig(**model_kwargs)
     train_cfg = TrainConfig(
         batch_size=args.batch_size,
         train_iters=args.train_iters,

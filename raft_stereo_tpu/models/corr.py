@@ -165,8 +165,9 @@ def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
     """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100).
 
     ``corr_w2_shards > 1`` routes to the disparity-axis-sharded volume
-    (parallel/corr_sharded.py) — the sharded form of ``reg`` (config
-    validation rejects other backends); activate a mesh with
+    (parallel/corr_sharded.py), valid for ``reg`` (XLA lookup per shard)
+    and ``reg_fused`` (Pallas lookup per shard); ``alt`` builds no volume
+    and is rejected at config validation.  Activate a mesh with
     ``corr_sharding(mesh)`` during tracing first."""
     if cfg.corr_w2_shards > 1:
         from raft_stereo_tpu.parallel.corr_sharded import (

@@ -24,9 +24,9 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+# Source ships as package data so pip installs keep the native path; the
+# library is built (and cached) next to it.
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_SRC_DIR, "stereo_native.cpp")
 _SO = os.path.join(_SRC_DIR, "libstereo_native.so")
 
@@ -68,7 +68,12 @@ def _load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SO) or (
                 os.path.exists(_SRC)
                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not (os.path.exists(_SRC) and _build()):
+            if not os.path.exists(_SRC):
+                log.info("native decoder source missing at %s; "
+                         "using Python readers", _SRC)
+                _build_failed = True
+                return None
+            if not _build():
                 _build_failed = True
                 return None
         try:

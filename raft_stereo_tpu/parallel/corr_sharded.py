@@ -93,6 +93,12 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
     num_levels = cfg.corr_levels
     radius = cfg.corr_radius
 
+    # reg semantics: build in fp32.  With the reg_fused backend the shard
+    # volumes are then *stored* in the incoming compute dtype (bf16 under
+    # mixed precision — halving per-shard HBM, the same trade the unsharded
+    # fused backend makes in models/corr.py).
+    store_dtype = fmap1.dtype if cfg.corr_backend == "reg_fused" \
+        else jnp.float32
     fmap1 = fmap1.astype(jnp.float32)
     fmap2 = fmap2.astype(jnp.float32)
     w2 = fmap2.shape[2]
@@ -119,7 +125,7 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
             # so boundary taps read zero exactly like out-of-range sampling.
             global_bin = shard * lw + jnp.arange(lw)
             vol = jnp.where(global_bin < widths[level], vol, 0.0)
-            pyramid.append(vol)
+            pyramid.append(vol.astype(store_dtype))
         return tuple(pyramid)
 
     # Manual only over ``corr``; the batch axis stays automatic so the outer
@@ -131,6 +137,14 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
                         for _ in range(num_levels)),
     )(fmap1, fmap2)
 
+    # The per-shard lookup uses the XLA sampler even for the reg_fused
+    # backend: the Pallas primitive carries no varying-axes annotation, so
+    # jax 0.9's partial-manual shard_map cannot vma-check it, and the
+    # check_vma=False escape hatch mis-validates out_specs in partial-manual
+    # mode (it reports the auto axis as referenced).  When either is fixed
+    # upstream, dispatch to kernels.corr_lookup._sample_level with
+    # shard-shifted coordinates here — the kernel math already supports it
+    # (out-of-shard taps get zero hat weights).
     def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
                      ) -> jnp.ndarray:
         shard = lax.axis_index(CORR_AXIS)
@@ -138,9 +152,10 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
         for level, vol in enumerate(pyr):
             offset = (shard * vol.shape[-1]).astype(coords.dtype)
             taps = _window_coords(coords, level, radius) - offset
-            outs.append(linear_sampler_1d(vol, taps))
+            outs.append(linear_sampler_1d(vol.astype(jnp.float32), taps))
         # Each global bin is owned by exactly one shard; out-of-shard taps
-        # contributed zero, so the sum IS the global interpolated window.
+        # contributed zero, so the cross-shard sum IS the global interpolated
+        # window.
         return lax.psum(jnp.concatenate(outs, axis=-1), CORR_AXIS)
 
     lookup = jax.shard_map(

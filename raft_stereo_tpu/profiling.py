@@ -99,7 +99,8 @@ class FpsProtocol:
 
 def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
                              k_lo: int = 3, k_hi: int = 23,
-                             repeats: int = 3) -> float:
+                             repeats: int = 3,
+                             reduce: Callable = min) -> float:
     """Dispatch-robust per-call device time.
 
     ``make_chain(k)`` must return a zero-arg callable that runs ``k``
@@ -107,16 +108,18 @@ def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
     difference ``(t(k_hi) - t(k_lo)) / (k_hi - k_lo)`` cancels constant
     dispatch/round-trip overhead — use when the device sits behind an async
     tunnel where ``block_until_ready`` returns at dispatch (see bench.py).
+    ``reduce`` combines the per-repeat estimates; ``min`` (default) filters
+    one-sided interference noise.
     """
     chains = {k: make_chain(k) for k in (k_lo, k_hi)}
     for k in (k_lo, k_hi):  # compile both
         chains[k]()
-    best = []
+    estimates = []
     for _ in range(repeats):
         ts = {}
         for k in (k_lo, k_hi):
             t0 = time.perf_counter()
             chains[k]()
             ts[k] = time.perf_counter() - t0
-        best.append((ts[k_hi] - ts[k_lo]) / (k_hi - k_lo))
-    return float(np.median(best))
+        estimates.append((ts[k_hi] - ts[k_lo]) / (k_hi - k_lo))
+    return float(reduce(estimates))

@@ -22,9 +22,13 @@ for res in Q H F; do
               "$ROOT/Middlebury"
 done
 
-mkdir -p "$ROOT/ETH3D/two_view_testing"
-wget -nv "https://www.eth3d.net/data/two_view_test.7z" \
-     -P "$ROOT/ETH3D/two_view_testing"
-(cd "$ROOT/ETH3D/two_view_testing" && 7za x -y two_view_test.7z && rm -f two_view_test.7z)
+# The validators read the TRAINING split + GT (data/datasets.py ETH3D globs
+# two_view_training/ and two_view_training_gt/); the test split has no GT
+# and is only needed for leaderboard submission.
+mkdir -p "$ROOT/ETH3D"
+for f in two_view_training two_view_training_gt; do
+  wget -nv "https://www.eth3d.net/data/${f}.7z" -P "$ROOT/ETH3D"
+  (cd "$ROOT/ETH3D" && 7za x -y "${f}.7z" -o"${f}" && rm -f "${f}.7z")
+done
 
 echo "Datasets ready under $ROOT"

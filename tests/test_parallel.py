@@ -69,6 +69,25 @@ def test_sharded_gradients_match_reg(rng):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_sharded_reg_fused_backend_matches_reg(rng):
+    """corr_w2_shards with the (default) reg_fused backend: the sharded
+    volume path must agree with the unsharded reg backend (fp32 inputs ⇒
+    fp32 shard storage ⇒ exact)."""
+    cfg = RaftStereoConfig(corr_w2_shards=2, corr_backend="reg_fused")
+    mesh = make_mesh(n_data=4, n_corr=2)
+    b, h, w1, w2 = 1, 4, 24, 40
+    f1, f2 = _fmaps(rng, b, h, w1, w2, d=8)
+    coords = _coords(rng, b, h, w1, w2)
+    ref = make_corr_fn_reg(RaftStereoConfig(corr_backend="reg"), f1, f2)(coords)
+
+    with corr_sharding(mesh):
+        out = jax.jit(
+            lambda c: make_corr_fn_w2_sharded(cfg, f1, f2, mesh)(c)
+        )(coords)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_dispatch_requires_active_mesh(rng):
     cfg = RaftStereoConfig(corr_w2_shards=2)
     f1, f2 = _fmaps(rng, 1, 2, 8, 8)
