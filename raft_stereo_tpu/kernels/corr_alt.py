@@ -1,0 +1,244 @@
+"""Pallas TPU kernel: fused no-volume ("alt") correlation lookup.
+
+TPU-native replacement for the reference's on-the-fly correlation backend
+(reference: core/corr.py:64-107 PytorchAlternateCorrBlock1D), which exists so
+full-resolution inputs never materialize the O(B·H·W1·W2) volume (reference:
+README.md:121 recommends it for Middlebury-F).  The reference samples right-
+feature windows with ``grid_sample`` and dots them with left features; on TPU
+both the gather and the tiny dot products are hostile.
+
+This kernel uses the algebraic identity
+
+    out[w, k] = Σ_d f1[w, d] · interp_k(f2)[w, d]
+              = hat_k ⊛ (f1 · f2ᵀ)[w, :]
+
+i.e. a linear-interpolated feature dot product IS a hat-function reduction of
+one row-block of the correlation volume.  So each (row, W1-block) tile:
+
+  1. computes its volume tile  v = f1_tile @ f2_rowᵀ / √D  on the MXU,
+     entirely in VMEM (never written to HBM — the fusion of SURVEY.md §7's
+     kernels 9b and 9c), then
+  2. hat-samples v exactly like the reg_fused lookup kernel
+     (kernels/corr_lookup.py).
+
+Per iteration this recomputes the volume tile (alt's memory/compute trade);
+across ``corr_levels`` the right features come from the W-pooled pyramid the
+XLA side builds once.
+
+Backward (custom VJP, mirroring the identity):
+    dv[w, x] = Σ_k g[w, k] · hat_k(x)        (the reg_fused backward kernel)
+    df1      = dv @ f2
+    df2      = dvᵀ @ f1
+both matmuls fused into the same tile pass, so the backward never
+materializes the volume either.  No coordinate gradient (RAFT detaches
+coords each iteration — reference core/raft_stereo.py:109).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.kernels.corr_lookup import fused_lookup_available
+
+ROW_BLK = 8       # (batch·H) rows per tile
+W1_BLK = 128      # output pixels per tile (lane-aligned)
+
+
+def _interpret() -> bool:
+    from raft_stereo_tpu.kernels import corr_lookup
+    return bool(corr_lookup._interpret_override)
+
+
+def alt_fused_available() -> bool:
+    return fused_lookup_available()
+
+
+# ------------------------------------------------------------------ kernels
+def _fwd_kernel(f1_ref, f2_ref, coords_ref, out_ref, *, radius: int,
+                scale: float, inv_sqrt_d: float, precision):
+    """(R, W1B, D) left tile + (R, W2, D) right rows + (R, W1B) centers
+    → (R, W1B, K) window correlations."""
+    f1 = f1_ref[:].astype(jnp.float32)
+    f2 = f2_ref[:].astype(jnp.float32)
+    # Volume tile on the MXU, VMEM-resident only: (R, W1B, W2).
+    v = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32,
+                            precision=precision) * inv_sqrt_d
+    w2 = f2_ref.shape[1]
+    centers = coords_ref[:].astype(jnp.float32) * scale
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        out_ref[:, :, k] = jnp.sum(v * w, axis=-1).astype(out_ref.dtype)
+
+
+def _bwd_kernel(f1_ref, f2_ref, coords_ref, g_ref, df1_ref, df2_ref, *,
+                radius: int, scale: float, inv_sqrt_d: float,
+                rows_total: int, w1_total: int, precision):
+    """Tile transpose: reconstruct dv from the output cotangent with hat
+    weights, then both feature gradients as matmuls of dv.
+
+    df2 is accumulated over W1 blocks (grid dim 1): each block owns the same
+    (R, W2, D) df2 tile, so the kernel adds into it after zeroing on the
+    first block — Pallas TPU grids execute sequentially per core, making the
+    accumulation race-free.
+
+    dv is masked to the logical (rows, W1) extent: df2 reduces over the W1
+    axis, so block-padding garbage (NaN in interpret mode) would otherwise
+    contaminate every output element.
+    """
+    f1 = f1_ref[:].astype(jnp.float32)
+    f2 = f2_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)          # (R, W1B, K)
+    w2 = f2_ref.shape[1]
+    centers = coords_ref[:].astype(jnp.float32) * scale
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    dv = jnp.zeros(centers.shape + (w2,), jnp.float32)   # (R, W1B, W2)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        dv = dv + g[:, :, k][..., None] * w
+    r_blk, w1_blk = centers.shape
+    row_idx = (pl.program_id(0) * r_blk
+               + jax.lax.broadcasted_iota(jnp.int32, (r_blk, w1_blk, 1), 0))
+    col_idx = (pl.program_id(1) * w1_blk
+               + jax.lax.broadcasted_iota(jnp.int32, (r_blk, w1_blk, 1), 1))
+    valid = (row_idx < rows_total) & (col_idx < w1_total)
+    dv = jnp.where(valid, dv * inv_sqrt_d, 0.0)
+    # df2 contracts over W1, so f1's padding must be zeroed as well:
+    # 0 (masked dv) x NaN (padded f1) would still poison the reduction.
+    f1 = jnp.where(valid, f1, 0.0)
+    # df1[r, w1, d] = Σ_x dv[r, w1, x] f2[r, x, d]
+    df1_ref[:] = jax.lax.dot_general(
+        dv, f2, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=precision).astype(df1_ref.dtype)
+    # df2[r, x, d] = Σ_w1 dv[r, w1, x] f1[r, w1, d], accumulated over blocks
+    contrib = jax.lax.dot_general(
+        dv, f1, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        df2_ref[:] = jnp.zeros_like(df2_ref)
+
+    df2_ref[:] += contrib.astype(df2_ref.dtype)
+
+
+# ------------------------------------------------------------------- launch
+def _precision_for(dtype) -> jax.lax.Precision:
+    """fp32 features pay for exact (HIGHEST) MXU passes, matching the reg
+    backend bit-for-bit; bf16 features take the fast single-pass path (the
+    same trade the reference's fp16 CUDA kernel makes)."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
+    rows, w1, d = f1.shape
+    w2 = f2.shape[1]
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, radius=radius, scale=scale,
+                          inv_sqrt_d=inv_sqrt_d,
+                          precision=_precision_for(f1.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k), f1.dtype),
+        interpret=_interpret(),
+    )(f1, f2, coords)
+
+
+def _launch_bwd(f1, f2, coords, g, radius, scale, inv_sqrt_d):
+    rows, w1, d = f1.shape
+    w2 = f2.shape[1]
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, radius=radius, scale=scale,
+                          inv_sqrt_d=inv_sqrt_d, rows_total=rows,
+                          w1_total=w1, precision=_precision_for(f1.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, w1, d), f1.dtype),
+            jax.ShapeDtypeStruct((rows, w2, d), f2.dtype),
+        ],
+        interpret=_interpret(),
+    )(f1, f2, coords, g)
+
+
+# -------------------------------------------------------------- level entry
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _alt_level(f1, f2, coords, radius: int, scale: float):
+    """(B,H,W1,D) left + (B,H,W2,D) right + (B,H,W1) centers
+    → (B,H,W1,2r+1) correlations at one pyramid level."""
+    b, h, w1, d = f1.shape
+    w2 = f2.shape[2]
+    inv_sqrt_d = 1.0 / math.sqrt(d)
+    out = _launch_fwd(f1.reshape(b * h, w1, d), f2.reshape(b * h, w2, d),
+                      coords.reshape(b * h, w1), radius, scale, inv_sqrt_d)
+    return out.reshape(b, h, w1, -1)
+
+
+def _alt_level_fwd(f1, f2, coords, radius, scale):
+    return _alt_level(f1, f2, coords, radius, scale), (f1, f2, coords)
+
+
+def _alt_level_bwd(radius, scale, residuals, g):
+    f1, f2, coords = residuals
+    b, h, w1, d = f1.shape
+    w2 = f2.shape[2]
+    inv_sqrt_d = 1.0 / math.sqrt(d)
+    df1, df2 = _launch_bwd(f1.reshape(b * h, w1, d),
+                           f2.reshape(b * h, w2, d),
+                           coords.reshape(b * h, w1),
+                           g.reshape(b * h, w1, -1), radius, scale,
+                           inv_sqrt_d)
+    return (df1.reshape(f1.shape), df2.reshape(f2.shape),
+            jnp.zeros_like(coords))
+
+
+_alt_level.defvjp(_alt_level_fwd, _alt_level_bwd)
+
+
+def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
+                     coords: jnp.ndarray, radius: int) -> jnp.ndarray:
+    """Fused no-volume window correlation at every level, concat level-major —
+    drop-in for the XLA alt lookup in models/corr.py make_corr_fn_alt."""
+    outs = [_alt_level(fmap1, f2, coords, radius, 1.0 / (2 ** i))
+            for i, f2 in enumerate(fmap2_pyramid)]
+    return jnp.concatenate(outs, axis=-1)

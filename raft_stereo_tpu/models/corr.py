@@ -107,13 +107,31 @@ def make_corr_fn_reg(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
 
 # --------------------------------------------------------------------- alt
 def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
-    fmap1 = fmap1.astype(jnp.float32)
-    fmap2 = fmap2.astype(jnp.float32)
+    # On TPU the whole lookup fuses into one Pallas kernel per level that
+    # computes volume tiles on the MXU in VMEM (never HBM) and hat-samples
+    # them — kernels/corr_alt.py.  The kernel keeps the incoming compute
+    # dtype (bf16 under mixed precision, like the reference's fp16 CUDA
+    # lookup; fp32 features get exact HIGHEST-precision MXU passes).  The
+    # XLA path below is the correctness reference and off-TPU fallback.
+    from raft_stereo_tpu.kernels.corr_alt import (alt_fused_available,
+                                                  alt_lookup_fused)
+    use_fused = alt_fused_available()
+    if not use_fused:
+        # XLA fallback runs in fp32 like the reference's alt backend
+        # (core/raft_stereo.py:95 forces fp32 for it).
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
     d = fmap1.shape[-1]
     # Progressively W-pooled right features (reference: core/corr.py:104).
     fmap2_pyramid = [fmap2]
     for _ in range(cfg.corr_levels - 1):
         fmap2_pyramid.append(pool_axis(fmap2_pyramid[-1], axis=2))
+
+    if use_fused:
+        def corr_fn(coords):
+            return alt_lookup_fused(fmap1, fmap2_pyramid, coords,
+                                    cfg.corr_radius)
+        return corr_fn
 
     def corr_fn(coords):
         outs = []
@@ -165,8 +183,10 @@ def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
     """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100).
 
     ``corr_w2_shards > 1`` routes to the disparity-axis-sharded volume
-    (parallel/corr_sharded.py), valid for ``reg`` (XLA lookup per shard)
-    and ``reg_fused`` (Pallas lookup per shard); ``alt`` builds no volume
+    (parallel/corr_sharded.py) for ``reg`` and ``reg_fused`` — both use the
+    XLA sampler per shard (jax cannot yet vma-check the Pallas primitive
+    inside a partial-manual shard_map; see corr_sharded.py); ``reg_fused``
+    only changes the shard-volume storage dtype.  ``alt`` builds no volume
     and is rejected at config validation.  Activate a mesh with
     ``corr_sharding(mesh)`` during tracing first."""
     if cfg.corr_w2_shards > 1:

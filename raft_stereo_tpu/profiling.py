@@ -100,7 +100,7 @@ class FpsProtocol:
 def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
                              k_lo: int = 3, k_hi: int = 23,
                              repeats: int = 3,
-                             reduce: Callable = min) -> float:
+                             reduce: Callable = np.median) -> float:
     """Dispatch-robust per-call device time.
 
     ``make_chain(k)`` must return a zero-arg callable that runs ``k``
@@ -108,8 +108,11 @@ def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
     difference ``(t(k_hi) - t(k_lo)) / (k_hi - k_lo)`` cancels constant
     dispatch/round-trip overhead — use when the device sits behind an async
     tunnel where ``block_until_ready`` returns at dispatch (see bench.py).
-    ``reduce`` combines the per-repeat estimates; ``min`` (default) filters
-    one-sided interference noise.
+    ``reduce`` combines the per-repeat estimates; the default ``median``
+    tolerates an outlier repeat.  Note ``min`` is the WRONG choice for this
+    difference estimator: a spike during a k_lo run biases that repeat's
+    difference low (possibly negative), and min would select exactly the
+    corrupted repeat.
     """
     chains = {k: make_chain(k) for k in (k_lo, k_hi)}
     for k in (k_lo, k_hi):  # compile both
@@ -122,4 +125,9 @@ def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
             chains[k]()
             ts[k] = time.perf_counter() - t0
         estimates.append((ts[k_hi] - ts[k_lo]) / (k_hi - k_lo))
-    return float(reduce(estimates))
+    per_call = float(reduce(estimates))
+    if per_call <= 0:
+        raise RuntimeError(
+            f"non-positive per-call estimate {per_call!r}: timing noise "
+            f"exceeded the chained workload; raise k_hi or repeats")
+    return per_call
