@@ -124,10 +124,13 @@ class RaftStereoConfig:
 
     @classmethod
     def realtime(cls) -> "RaftStereoConfig":
-        """The realtime config (reference: README.md:84 uses reg_cuda there;
-        on TPU the fused no-volume 'alt' kernel is the fastest backend —
-        measured 193 vs 110 FPS against reg_fused at KITTI resolution on one
-        chip, bf16 volume tiles computed in VMEM, never in HBM)."""
+        """The realtime config (reference: README.md:84 uses reg_cuda there).
+
+        On TPU the fused no-volume 'alt' kernel is the chosen backend:
+        sustained throughput ties reg_fused (106-142 vs 110-141 FPS at
+        KITTI resolution on one chip), bursts run ~1.5x faster (193-218
+        FPS), and the correlation volume never exists in HBM (tiles are
+        computed in VMEM), freeing memory for larger batches/resolutions."""
         return cls(shared_backbone=True, n_downsample=3, n_gru_layers=2,
                    slow_fast_gru=True, corr_backend="alt",
                    mixed_precision=True)

@@ -27,20 +27,32 @@ class StereoLoader:
 
     Args:
       dataset: a ``StereoDataset`` (samples must share one crop size).
-      batch_size: global batch size; ``drop_last`` semantics always on.
+      batch_size: GLOBAL batch size; ``drop_last`` semantics always on.
       shuffle: re-permute every epoch with ``seed + epoch``.
       num_workers: decode threads; 0 = synchronous in-caller decode.
       prefetch: max ready batches buffered ahead.
       epochs: None = loop forever.
+      process_index/process_count: multi-host data sharding — every process
+        draws the same seeded permutation but decodes only its contiguous
+        slice of each global batch (``parallel.distributed`` supplies these;
+        ``mesh.shard_batch`` reassembles the global array).  Yielded batches
+        then have ``batch_size // process_count`` samples.
     """
 
     def __init__(self, dataset: StereoDataset, batch_size: int,
                  shuffle: bool = True, num_workers: int = 4,
                  prefetch: int = 2, seed: int = 1234,
-                 epochs: Optional[int] = None):
+                 epochs: Optional[int] = None,
+                 process_index: int = 0, process_count: int = 1):
         if len(dataset) < batch_size:
             raise ValueError(
                 f"dataset has {len(dataset)} samples < batch_size={batch_size}")
+        if batch_size % process_count:
+            raise ValueError(f"batch_size={batch_size} not divisible by "
+                             f"process_count={process_count}")
+        if not (0 <= process_index < process_count):
+            raise ValueError(f"process_index={process_index} out of range "
+                             f"for process_count={process_count}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -48,6 +60,8 @@ class StereoLoader:
         self.prefetch = prefetch
         self.seed = seed
         self.epochs = epochs
+        self.process_index = process_index
+        self.process_count = process_count
 
     def __len__(self) -> int:
         return len(self.dataset) // self.batch_size  # drop_last
@@ -70,12 +84,15 @@ class StereoLoader:
             yield from self._iter_threaded()
 
     def _batch_indices(self):
+        local = self.batch_size // self.process_count
+        lo = self.process_index * local
         epoch = 0
         while self.epochs is None or epoch < self.epochs:
             order = self._epoch_order(epoch)
             for i in range(len(self)):
-                yield epoch, order[i * self.batch_size:
-                                   (i + 1) * self.batch_size]
+                global_slice = order[i * self.batch_size:
+                                     (i + 1) * self.batch_size]
+                yield epoch, global_slice[lo:lo + local]
             epoch += 1
 
     def _iter_sync(self):

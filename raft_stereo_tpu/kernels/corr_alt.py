@@ -45,15 +45,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_stereo_tpu.kernels.corr_lookup import fused_lookup_available
-
-ROW_BLK = 8       # (batch·H) rows per tile
-W1_BLK = 128      # output pixels per tile (lane-aligned)
-
-
-def _interpret() -> bool:
-    from raft_stereo_tpu.kernels import corr_lookup
-    return bool(corr_lookup._interpret_override)
+from raft_stereo_tpu.kernels.corr_lookup import (ROW_BLK, W1_BLK,
+                                                 fused_lookup_available,
+                                                 hat_sample, hat_scatter,
+                                                 interpret_enabled as
+                                                 _interpret)
 
 
 def alt_fused_available() -> bool:
@@ -71,13 +67,9 @@ def _fwd_kernel(f1_ref, f2_ref, coords_ref, out_ref, *, radius: int,
     v = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32,
                             precision=precision) * inv_sqrt_d
-    w2 = f2_ref.shape[1]
     centers = coords_ref[:].astype(jnp.float32) * scale
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
-    for k in range(2 * radius + 1):
-        pos = centers + (k - radius)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        out_ref[:, :, k] = jnp.sum(v * w, axis=-1).astype(out_ref.dtype)
+    for k, sample in hat_sample(v, centers, radius):
+        out_ref[:, :, k] = sample.astype(out_ref.dtype)
 
 
 def _bwd_kernel(f1_ref, f2_ref, coords_ref, g_ref, df1_ref, df2_ref, *,
@@ -100,12 +92,7 @@ def _bwd_kernel(f1_ref, f2_ref, coords_ref, g_ref, df1_ref, df2_ref, *,
     g = g_ref[:].astype(jnp.float32)          # (R, W1B, K)
     w2 = f2_ref.shape[1]
     centers = coords_ref[:].astype(jnp.float32) * scale
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
-    dv = jnp.zeros(centers.shape + (w2,), jnp.float32)   # (R, W1B, W2)
-    for k in range(2 * radius + 1):
-        pos = centers + (k - radius)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        dv = dv + g[:, :, k][..., None] * w
+    dv = hat_scatter(g, centers, w2, radius)   # (R, W1B, W2)
     r_blk, w1_blk = centers.shape
     row_idx = (pl.program_id(0) * r_blk
                + jax.lax.broadcasted_iota(jnp.int32, (r_blk, w1_blk, 1), 0))

@@ -48,37 +48,57 @@ def fused_lookup_available() -> bool:
         return False
 
 
-def _interpret() -> bool:
+def interpret_enabled() -> bool:
+    """True when kernels run via the HLO interpreter (CPU tests)."""
     return bool(_interpret_override)
+
+
+_interpret = interpret_enabled  # internal alias
+
+
+# -------------------------------------------------- shared hat-sample math
+# The hat-function formulation (module docstring) shared by this kernel and
+# the fused no-volume kernel (kernels/corr_alt.py) — one implementation so
+# boundary/interpolation semantics can never diverge between them.
+def hat_sample(v, centers, radius: int):
+    """Σ_x v[..., x] · hat_k(x) for each tap k: (R, W1B, W2) tile +
+    (R, W1B) centers → per-tap sampler yielding (R, W1B) slices."""
+    w2 = v.shape[-1]
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)                  # (R, W1B)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        yield k, jnp.sum(v * w, axis=-1)
+
+
+def hat_scatter(g, centers, w2: int, radius: int):
+    """Transpose of :func:`hat_sample`: (R, W1B, K) cotangent + centers
+    → (R, W1B, W2) volume cotangent."""
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    acc = jnp.zeros(centers.shape + (w2,), jnp.float32)
+    for k in range(2 * radius + 1):
+        pos = centers + (k - radius)
+        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
+        acc = acc + g[:, :, k][..., None] * w
+    return acc
 
 
 # ------------------------------------------------------------------ kernels
 def _fwd_kernel(vol_ref, coords_ref, out_ref, *, radius: int, scale: float):
     """One (ROW_BLK, W1_BLK) tile: volume (R, W1B, W2) + centers (R, W1B)
     → window samples (R, W1B, K)."""
-    w2 = vol_ref.shape[-1]
     vol = vol_ref[:].astype(jnp.float32)              # (R, W1B, W2)
     centers = coords_ref[:].astype(jnp.float32) * scale   # (R, W1B)
-    # Mosaic only supports integer iota; cast to float after
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
-    for k in range(2 * radius + 1):
-        pos = centers + (k - radius)                  # (R, W1B)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        out_ref[:, :, k] = jnp.sum(vol * w, axis=-1).astype(out_ref.dtype)
+    for k, sample in hat_sample(vol, centers, radius):
+        out_ref[:, :, k] = sample.astype(out_ref.dtype)
 
 
 def _bwd_kernel(coords_ref, g_ref, dvol_ref, *, radius: int, scale: float):
     """Tile transpose of the forward: g (R, W1B, K) → dV (R, W1B, W2)."""
     centers = coords_ref[:].astype(jnp.float32) * scale
     g = g_ref[:].astype(jnp.float32)
-    w2 = dvol_ref.shape[-1]
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
-    acc = jnp.zeros(dvol_ref.shape, jnp.float32)
-    for k in range(2 * radius + 1):
-        pos = centers + (k - radius)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        acc = acc + g[:, :, k][..., None] * w
-    dvol_ref[:] = acc.astype(dvol_ref.dtype)
+    dvol = hat_scatter(g, centers, dvol_ref.shape[-1], radius)
+    dvol_ref[:] = dvol.astype(dvol_ref.dtype)
 
 
 # ------------------------------------------------------------------- launch

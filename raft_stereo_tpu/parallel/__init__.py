@@ -1,3 +1,4 @@
+from raft_stereo_tpu.parallel import distributed
 from raft_stereo_tpu.parallel.corr_sharded import (active_corr_mesh,
                                                    corr_sharding,
                                                    make_corr_fn_w2_sharded)
@@ -5,4 +6,5 @@ from raft_stereo_tpu.parallel.mesh import (DATA_AXIS, CORR_AXIS, make_mesh,
                                            shard_batch, replicate)
 
 __all__ = ["DATA_AXIS", "CORR_AXIS", "make_mesh", "shard_batch", "replicate",
-           "corr_sharding", "active_corr_mesh", "make_corr_fn_w2_sharded"]
+           "corr_sharding", "active_corr_mesh", "make_corr_fn_w2_sharded",
+           "distributed"]

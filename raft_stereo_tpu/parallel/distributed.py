@@ -1,0 +1,106 @@
+"""Multi-host (DCN) distributed runtime.
+
+The reference's only parallelism is single-process ``nn.DataParallel``
+(reference: train_stereo.py:134) — no NCCL/MPI process groups exist there.
+This module is the TPU-native communication backend that *replaces* that
+stack: one jax process per host, ``jax.distributed.initialize`` over DCN,
+and after that every collective (gradient psum, corr-shard psum) is an XLA
+collective riding ICI within a slice and DCN across slices.  Nothing else
+in the framework changes — the SPMD train step (training/step.py) and the
+``(data, corr)`` mesh (parallel/mesh.py) are already global-view; this
+module only supplies process bootstrap and per-process data sharding.
+
+Usage (same program on every host):
+
+    from raft_stereo_tpu.parallel import distributed
+    distributed.initialize()            # no-op in single-process runs
+    mesh = make_mesh()                  # spans ALL hosts' devices
+    loader = StereoLoader(ds, batch_size=global_batch,
+                          **distributed.loader_shard_kwargs())
+    batch = shard_batch(local_batch, mesh)   # assembles the global array
+
+On Cloud TPU, ``initialize()`` autodetects coordinator/process topology
+from the TPU metadata; elsewhere set ``coordinator_address`` /
+``num_processes`` / ``process_id`` explicitly (or the standard
+``JAX_COORDINATOR_ADDRESS`` etc. environment variables).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+# Environment markers of a multi-process topology jax can auto-detect
+# (explicit coordinator env, Cloud TPU pod workers, SLURM/OpenMPI ranks).
+# Checked WITHOUT touching any jax API: jax.distributed.initialize must run
+# before the first device query latches the backend, so the guard must not
+# query jax itself.
+_TOPOLOGY_ENV = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                 "MEGASCALE_COORDINATOR_ADDRESS")
+
+
+def _env_topology_present() -> bool:
+    if any(os.environ.get(k) for k in _TOPOLOGY_ENV):
+        return True
+    # A TPU pod lists MULTIPLE workers (comma-separated); a single hostname
+    # is just a 1-worker slice and needs no process group.
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True
+    for k in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(k, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bootstrap multi-process jax; safe to call in single-process runs.
+
+    Must run before the first device query in the process (jax latches the
+    backend on first use) — which is why the single-process guard inspects
+    only the environment, never jax state.  Idempotent."""
+    global _initialized
+    if _initialized or jax.distributed.is_initialized():
+        _initialized = True
+        return
+    if (coordinator_address is None and num_processes is None
+            and process_id is None and not _env_topology_present()):
+        # Plain single-process run with no detectable topology: nothing to
+        # do, and calling jax.distributed.initialize would fail.
+        _initialized = True
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    log.info("distributed: process %d/%d, %d local of %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def loader_shard_kwargs() -> Dict[str, int]:
+    """Per-process data-sharding kwargs for ``StereoLoader``: each process
+    decodes only its slice of every global batch."""
+    return {"process_index": jax.process_index(),
+            "process_count": jax.process_count()}
+
+
+def assert_valid_global_batch(global_batch: int) -> int:
+    """Validate and return the per-process batch size."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{n} processes")
+    return global_batch // n

@@ -25,6 +25,7 @@ import numpy as np
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
 from raft_stereo_tpu.data.datasets import build_training_mixture
 from raft_stereo_tpu.data.loader import StereoLoader
+from raft_stereo_tpu.parallel import distributed
 from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
 from raft_stereo_tpu.training import checkpoint as ckpt
@@ -113,7 +114,8 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     if loader is None:
         mixture = build_training_mixture(train_cfg, data_root)
         loader = StereoLoader(mixture, batch_size=train_cfg.batch_size,
-                              seed=train_cfg.seed)
+                              seed=train_cfg.seed,
+                              **distributed.loader_shard_kwargs())
     step_fn = make_train_step(train_cfg, mesh=mesh)
     _, schedule = make_optimizer(train_cfg)
     logger = Logger(log_dir=log_dir, total_steps=start_step)

@@ -24,26 +24,11 @@ def _interpret_mode():
 
 
 def _xla_alt(cfg, f1, f2):
-    """The pure-XLA alt path, bypassing the fused dispatch."""
-    assert not corr_alt.alt_fused_available.__wrapped__() \
-        if hasattr(corr_alt.alt_fused_available, "__wrapped__") else True
-    from raft_stereo_tpu.models import corr as corr_mod
-    import math
-    fmap2_pyramid = [f2]
-    for _ in range(cfg.corr_levels - 1):
-        fmap2_pyramid.append(corr_mod.pool_axis(fmap2_pyramid[-1], axis=2))
-    d = f1.shape[-1]
-
-    def fn(coords):
-        outs = []
-        for i, f2l in enumerate(fmap2_pyramid):
-            taps = corr_mod._window_coords(coords, i, cfg.corr_radius)
-            sampled = corr_mod.linear_sampler_1d_features(f2l, taps)
-            outs.append(jnp.einsum("bhwd,bhwkd->bhwk", f1, sampled,
-                                   precision=jax.lax.Precision.HIGHEST)
-                        / math.sqrt(d))
-        return jnp.concatenate(outs, axis=-1)
-    return fn
+    """The REAL pure-XLA alt fallback in make_corr_fn_alt, reached by
+    forcing the fused dispatch off."""
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(corr_alt, "alt_fused_available", lambda: False)
+        return make_corr_fn_alt(cfg, f1, f2)
 
 
 @pytest.mark.parametrize("w2", [40, 37])
