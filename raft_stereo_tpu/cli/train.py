@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    # Must precede any jax device query (the backend latches on first use);
+    # no-op off multi-host topologies.
+    from raft_stereo_tpu.parallel import distributed
+    distributed.initialize()
+
     common.setup_logging()
     args = build_parser().parse_args(argv)
     model_cfg, train_cfg = configs_from_args(args)

@@ -91,16 +91,7 @@ def initialize(coordinator_address: Optional[str] = None,
 
 def loader_shard_kwargs() -> Dict[str, int]:
     """Per-process data-sharding kwargs for ``StereoLoader``: each process
-    decodes only its slice of every global batch."""
+    decodes only its contiguous slice of every global batch (the loader
+    validates divisibility)."""
     return {"process_index": jax.process_index(),
             "process_count": jax.process_count()}
-
-
-def assert_valid_global_batch(global_batch: int) -> int:
-    """Validate and return the per-process batch size."""
-    n = jax.process_count()
-    if global_batch % n:
-        raise ValueError(
-            f"global batch {global_batch} not divisible by "
-            f"{n} processes")
-    return global_batch // n

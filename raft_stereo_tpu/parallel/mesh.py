@@ -11,10 +11,17 @@ the correlation volume — the "long-context" analog for full-resolution inputs
 (SURVEY.md §5).  It is wired up by ``parallel/corr_sharded.py``; plain
 data-parallel training should use ``n_corr=1``.
 
-Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh`` — the
-mesh then spans all hosts' devices and data loading shards per-process
-(``process_index``-strided), with gradient collectives riding ICI within a
-slice and DCN across slices.  Nothing else changes; that is the point of SPMD.
+Multi-host: call ``parallel.distributed.initialize()`` before ``make_mesh`` —
+the mesh then spans all hosts' devices, with gradient collectives riding ICI
+within a slice and DCN across slices.  Data loading shards per process as
+CONTIGUOUS slices of each global batch (``StereoLoader`` process_index/
+process_count): ``jax.devices()`` orders devices by process index, so with
+the default mesh layout process ``p``'s addressable ``data``-axis rows are
+exactly rows ``[p*local, (p+1)*local)`` of the global batch, and
+``make_array_from_process_local_data`` in ``shard_batch`` reassembles the
+global array without any permutation.  Keep loader slicing and mesh device
+order in sync if either changes.  Nothing else changes; that is the point
+of SPMD.
 """
 
 from __future__ import annotations

@@ -55,6 +55,9 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     ``train_cfg.validation_frequency`` steps.
     ``loader`` overrides dataset construction (used by tests).
     """
+    # Defensive: form the process group (no-op single-host / already done)
+    # BEFORE the jax.devices() call below latches the backend.
+    distributed.initialize()
     devices = jax.devices()
     n_corr = model_cfg.corr_w2_shards
     if n_corr > 1 and not use_mesh:

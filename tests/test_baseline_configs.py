@@ -1,0 +1,121 @@
+"""The five BASELINE.json benchmark configurations, exercised end-to-end on
+CPU at reduced size: every config must build, jit, run forward (and for the
+training config, one optimization step) with finite outputs.
+
+These are the shapes the driver/judge measures on hardware; this file
+guarantees none of them can rot between benchmark runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+
+def _images(rng, h=64, w=96):
+    i1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    return i1, jnp.asarray(np.roll(np.asarray(i1), -4, axis=2))
+
+
+def _forward(cfg, rng, iters, h=64, w=96):
+    model = RAFTStereo(cfg)
+    i1, i2 = _images(rng, h, w)
+    v = model.init(jax.random.PRNGKey(0), i1, i2, iters=1, test_mode=True)
+    lo, up = jax.jit(
+        lambda v_, a, b: model.apply(v_, a, b, iters=iters, test_mode=True)
+    )(v, i1, i2)
+    assert up.shape == i1.shape[:3]
+    assert np.isfinite(np.asarray(up)).all()
+    return np.asarray(up)
+
+
+def test_config1_eth3d_reg_32iters(rng):
+    """BASELINE config 1: the eth3d architecture, reg backend, 32 iters."""
+    _forward(RaftStereoConfig(corr_backend="reg"), rng, iters=32)
+
+
+def test_config2_realtime_7iters(rng):
+    """BASELINE config 2: the realtime preset, 7 iters."""
+    _forward(RaftStereoConfig.realtime(), rng, iters=7)
+
+
+def test_config3_middlebury_alt_fullres_shape(rng):
+    """BASELINE config 3: alt (no-volume) backend at an odd, non-/32 aspect
+    (full-res Middlebury shapes are odd; padding handles them)."""
+    from raft_stereo_tpu.ops.padding import InputPadder
+
+    cfg = RaftStereoConfig(corr_backend="alt")
+    model = RAFTStereo(cfg)
+    h, w = 61, 107  # odd dimensions, exercise pad→forward→unpad
+    i1 = jnp.asarray(np.random.default_rng(0).uniform(0, 255, (1, h, w, 3)),
+                     jnp.float32)
+    padder = InputPadder(i1.shape, divis_by=32)
+    p1, p2 = padder.pad(i1, i1)
+    v = model.init(jax.random.PRNGKey(0), p1, p2, iters=1, test_mode=True)
+    _, up = model.apply(v, p1, p2, iters=4, test_mode=True)
+    out = padder.unpad(up)
+    assert out.shape == (1, h, w)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_config4_sceneflow_training_step(rng):
+    """BASELINE config 4: the SceneFlow training configuration (scaled
+    down), one jitted train step with mixed precision."""
+    from raft_stereo_tpu.training.state import create_train_state
+    from raft_stereo_tpu.training.step import make_train_step
+
+    model_cfg = RaftStereoConfig(mixed_precision=True, n_downsample=2)
+    train_cfg = TrainConfig(batch_size=2, train_iters=4,
+                            image_size=(64, 96), data_parallel=1)
+    state = create_train_state(model_cfg, train_cfg, jax.random.PRNGKey(0),
+                               image_shape=(1, 64, 96, 3))
+    step = make_train_step(train_cfg, mesh=None, donate=False)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (2, 64, 96, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (2, 64, 96, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (2, 64, 96)), jnp.float32),
+        "valid": jnp.ones((2, 64, 96), jnp.float32),
+    }
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(new_state.step) == 1
+
+
+def test_config5_kitti_eval_protocol(rng, tmp_path):
+    """BASELINE config 5: the KITTI validator protocol (pad→forward→unpad→
+    EPE/D1 masks) on a synthetic pair through the real validate path."""
+    import os
+    from PIL import Image
+    from raft_stereo_tpu.data import frame_utils as fu
+    from raft_stereo_tpu.data.datasets import KITTI
+    from raft_stereo_tpu.eval.validate import validate_kitti
+
+    root = str(tmp_path)
+    for d in ("training/image_2", "training/image_3", "training/disp_occ_0"):
+        os.makedirs(os.path.join(root, d))
+    for i in range(2):
+        for cam in ("image_2", "image_3"):
+            Image.fromarray(np.asarray(
+                rng.integers(0, 256, (64, 96, 3)), np.uint8)).save(
+                os.path.join(root, "training", cam, f"{i:06d}_10.png"))
+        disp = rng.uniform(1, 20, (64, 96)).astype(np.float32)
+        fu.write_disp_kitti(
+            os.path.join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
+            disp)
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), fnet_dim=64)
+    model = RAFTStereo(cfg)
+    i1, i2 = _images(rng)
+    variables = model.init(jax.random.PRNGKey(0), i1, i2, iters=1,
+                           test_mode=True)
+    runner = InferenceRunner(cfg, variables, iters=2)
+    result = validate_kitti(runner, root=root)
+    assert "kitti-epe" in result and "kitti-d1" in result
+    assert np.isfinite(result["kitti-epe"])

@@ -53,4 +53,3 @@ def test_initialize_single_process_noop():
     distributed.initialize()  # must not raise or hang in 1-process runs
     kw = distributed.loader_shard_kwargs()
     assert kw == {"process_index": 0, "process_count": 1}
-    assert distributed.assert_valid_global_batch(8) == 8  # 1 process: identity
