@@ -88,13 +88,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
-    # Must precede any jax device query (the backend latches on first use);
-    # no-op off multi-host topologies.
-    from raft_stereo_tpu.parallel import distributed
-    distributed.initialize()
-
     common.setup_logging()
     args = build_parser().parse_args(argv)
+
+    # Must run after arg parsing (--help/usage errors must not block forming
+    # a process group) but before any jax device query latches the backend.
+    from raft_stereo_tpu.parallel import distributed
+    distributed.initialize()
     model_cfg, train_cfg = configs_from_args(args)
     log.info("model config: %s", model_cfg.to_dict())
     log.info("train config: %s", train_cfg.to_dict())

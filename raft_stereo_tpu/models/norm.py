@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -51,6 +52,12 @@ class InstanceNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # Materialize the input before the spatial reductions: without the
+        # barrier XLA duplicates the producer convolution into each
+        # reduction fusion (mean, var, normalize = 3 consumers), tripling
+        # conv work — measured 4.3ms vs 1.9ms per residual block at
+        # (2,192,624,64) on a v5e chip, ~60ms across the fp32 fnet.
+        x = jax.lax.optimization_barrier(x)
         # Compute statistics in fp32 for stability, return in input dtype.
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=(1, 2), keepdims=True)

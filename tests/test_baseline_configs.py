@@ -42,7 +42,7 @@ def test_config2_realtime_7iters(rng):
     _forward(RaftStereoConfig.realtime(), rng, iters=7)
 
 
-def test_config3_middlebury_alt_fullres_shape(rng):
+def test_config3_middlebury_alt_fullres_shape():
     """BASELINE config 3: alt (no-volume) backend at an odd, non-/32 aspect
     (full-res Middlebury shapes are odd; padding handles them)."""
     from raft_stereo_tpu.ops.padding import InputPadder
@@ -92,7 +92,6 @@ def test_config5_kitti_eval_protocol(rng, tmp_path):
     import os
     from PIL import Image
     from raft_stereo_tpu.data import frame_utils as fu
-    from raft_stereo_tpu.data.datasets import KITTI
     from raft_stereo_tpu.eval.validate import validate_kitti
 
     root = str(tmp_path)
