@@ -16,6 +16,8 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import signal
+import threading
 import time
 from typing import Dict, Optional
 
@@ -127,9 +129,38 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     total = train_cfg.num_steps
     step = start_step
     t0 = time.time()
+
+    # Preemption safety (beyond the reference, which loses up to 10k steps on
+    # a kill — SURVEY.md §5): SIGTERM/SIGINT request a checkpoint at the next
+    # step boundary, then a clean exit.  Preempted TPU VMs deliver SIGTERM;
+    # with exact-resume checkpoints the run continues where it stopped.
+    stop_requested = False
+    prev_handlers = {}
+
+    def _restore_handlers():
+        while prev_handlers:
+            sig, h = prev_handlers.popitem()
+            signal.signal(sig, h)
+
+    def _request_stop(signum, frame):
+        nonlocal stop_requested
+        if stop_requested:
+            # Second signal: force quit.  (Keeping the handler installed
+            # until then protects the preemption checkpoint write itself
+            # from a single signal.)
+            _restore_handlers()
+            raise KeyboardInterrupt(f"second signal {signum}: force quit")
+        stop_requested = True
+        log.warning("signal %d: checkpointing at next step boundary "
+                    "(send again to force-quit)", signum)
+
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers[sig] = signal.signal(sig, _request_stop)
+
     try:
         for batch in loader:
-            if step >= total:
+            if step >= total or stop_requested:
                 break
             if mesh is not None:
                 batch = shard_batch(batch, mesh)
@@ -147,10 +178,18 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                                  "batch_stats":
                                      jax.device_get(state.batch_stats) or {}}
                     logger.write_dict(validate_fn(variables))
+        # Final (or preemption) checkpoint — written while the stop-request
+        # handler may still be installed, so a first signal here cannot kill
+        # a half-written save.
+        _save(os.path.join(checkpoint_dir, name), model_cfg, state, step)
     finally:
         logger.close()
+        _restore_handlers()
 
-    _save(os.path.join(checkpoint_dir, name), model_cfg, state, step)
+    if stop_requested:
+        log.warning("stopped by signal at step %d; resume with "
+                    "--restore_ckpt %s", step,
+                    os.path.join(checkpoint_dir, name))
     log.info("training done: %d steps in %.1fs", step - start_step,
              time.time() - t0)
     return state
