@@ -222,10 +222,113 @@ def _alt_level_bwd(radius, scale, residuals, g):
 _alt_level.defvjp(_alt_level_fwd, _alt_level_bwd)
 
 
+# ---------------------------------------------------- multi-level forward
+# All pyramid levels in ONE kernel launch: the right-feature pyramid is
+# concatenated along W (static level offsets) and each tile computes every
+# level's volume slice + hat-samples it in the same pass.  Bit-identical to
+# the per-level launches and ~1.5x faster at realtime shapes (410us ->
+# 274us measured on a v5e chip) — launch overhead dominates at small W2.
+def _fwd_multi_kernel(f1_ref, f2cat_ref, coords_ref, out_ref, *, radius: int,
+                      offsets, widths, inv_sqrt_d: float, precision):
+    f1 = f1_ref[:].astype(jnp.float32)
+    centers0 = coords_ref[:].astype(jnp.float32)
+    k = 2 * radius + 1
+    for lvl, (off, w2) in enumerate(zip(offsets, widths)):
+        f2 = f2cat_ref[:, off:off + w2, :].astype(jnp.float32)
+        v = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32,
+                                precision=precision) * inv_sqrt_d
+        for kk, sample in hat_sample(v, centers0 / (2 ** lvl), radius):
+            out_ref[:, :, lvl * k + kk] = sample.astype(out_ref.dtype)
+
+
+# Per-tile VMEM budget for the single-launch kernel's fp32 working set
+# (f2cat upcast + f1 tile + the largest per-level volume tile).  The kernel
+# computes in fp32 REGARDLESS of input dtype, so the guard measures fp32
+# bytes; over budget falls back to per-level launches (full-res pyramids).
+_MULTI_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _alt_multi(f1, f2cat, coords, static):
+    """Single-launch all-levels lookup.  ``static`` = (radius, offsets,
+    widths) as hashable tuples."""
+    radius, offsets, widths = static
+    b, h, w1, d = f1.shape
+    wcat = f2cat.shape[2]
+    rows = b * h
+    k = (2 * radius + 1) * len(offsets)
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    out = pl.pallas_call(
+        functools.partial(_fwd_multi_kernel, radius=radius, offsets=offsets,
+                          widths=widths, inv_sqrt_d=1.0 / math.sqrt(d),
+                          precision=_precision_for(f1.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, wcat, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k), f1.dtype),
+        interpret=_interpret(),
+    )(f1.reshape(rows, w1, d), f2cat.reshape(rows, wcat, d),
+      coords.reshape(rows, w1))
+    return out.reshape(b, h, w1, k)
+
+
+def _alt_multi_fwd(f1, f2cat, coords, static):
+    return _alt_multi(f1, f2cat, coords, static), (f1, f2cat, coords)
+
+
+def _alt_multi_bwd(static, residuals, g):
+    # Backward calls the per-level backward launch directly (training cost
+    # is conv-dominated; the forward launch count is what matters for
+    # inference latency).
+    radius, offsets, widths = static
+    f1, f2cat, coords = residuals
+    k = 2 * radius + 1
+    df1 = jnp.zeros_like(f1)
+    df2_parts = []
+    for lvl, (off, w2) in enumerate(zip(offsets, widths)):
+        f2 = f2cat[:, :, off:off + w2, :]
+        d1, d2, _ = _alt_level_bwd(radius, 1.0 / (2 ** lvl),
+                                   (f1, f2, coords),
+                                   g[..., lvl * k:(lvl + 1) * k])
+        df1 = df1 + d1
+        df2_parts.append(d2)
+    return df1, jnp.concatenate(df2_parts, axis=2), jnp.zeros_like(coords)
+
+
+_alt_multi.defvjp(_alt_multi_fwd, _alt_multi_bwd)
+
+
 def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
                      coords: jnp.ndarray, radius: int) -> jnp.ndarray:
     """Fused no-volume window correlation at every level, concat level-major —
-    drop-in for the XLA alt lookup in models/corr.py make_corr_fn_alt."""
+    drop-in for the XLA alt lookup in models/corr.py make_corr_fn_alt.
+
+    Uses the single-launch all-levels kernel when the concatenated right
+    features fit the per-tile VMEM budget; otherwise one launch per level."""
+    wcat = sum(f2.shape[2] for f2 in fmap2_pyramid)
+    d = fmap1.shape[-1]
+    w2_max = max(f2.shape[2] for f2 in fmap2_pyramid)
+    fp32 = 4  # the kernel upcasts to fp32 whatever the input dtype
+    working_set = (ROW_BLK * wcat * d * fp32          # f2cat upcast
+                   + ROW_BLK * W1_BLK * d * fp32      # f1 tile upcast
+                   + ROW_BLK * W1_BLK * w2_max * fp32)  # largest volume tile
+    if working_set <= _MULTI_VMEM_BUDGET:
+        static = (radius,
+                  tuple(int(sum(f.shape[2] for f in fmap2_pyramid[:i]))
+                        for i in range(len(fmap2_pyramid))),
+                  tuple(int(f.shape[2]) for f in fmap2_pyramid))
+        f2cat = jnp.concatenate(fmap2_pyramid, axis=2)
+        return _alt_multi(fmap1, f2cat, coords, static)
+
     outs = [_alt_level(fmap1, f2, coords, radius, 1.0 / (2 ** i))
             for i, f2 in enumerate(fmap2_pyramid)]
     return jnp.concatenate(outs, axis=-1)

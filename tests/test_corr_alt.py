@@ -68,6 +68,32 @@ def test_alt_fused_gradients_match_xla(rng, _interpret_mode):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_alt_per_level_fallback_matches_multi(rng, _interpret_mode,
+                                              monkeypatch):
+    """The per-level launch path (taken at full resolution, over the VMEM
+    budget) must agree with the single-launch multi-level path."""
+    cfg = RaftStereoConfig(corr_backend="alt")
+    b, h, w1, w2, d = 1, 4, 24, 40, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w1, d)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((b, h, w2, d)), jnp.float32)
+    coords = jnp.asarray(rng.uniform(-3, w2 + 3, (b, h, w1)), jnp.float32)
+
+    multi = make_corr_fn_alt(cfg, f1, f2)(coords)
+    monkeypatch.setattr(corr_alt, "_MULTI_VMEM_BUDGET", 0)
+    per_level = make_corr_fn_alt(cfg, f1, f2)(coords)
+    np.testing.assert_array_equal(np.asarray(multi), np.asarray(per_level))
+
+    # gradients through the per-level path too
+    cot = jnp.asarray(rng.standard_normal(multi.shape), jnp.float32)
+    g1 = jax.grad(lambda a: jnp.sum(make_corr_fn_alt(cfg, a, f2)(coords)
+                                    * cot))(f1)
+    monkeypatch.undo()
+    g2 = jax.grad(lambda a: jnp.sum(make_corr_fn_alt(cfg, a, f2)(coords)
+                                    * cot))(f1)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_alt_fused_model_forward(rng, _interpret_mode):
     """Whole model with the alt backend routes through the fused kernel in
     interpret mode and stays finite."""
