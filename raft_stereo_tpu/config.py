@@ -57,6 +57,13 @@ class RaftStereoConfig:
     shared_backbone: bool = False  # fnet shares the cnet trunk (core/raft_stereo.py:34-39)
     slow_fast_gru: bool = False    # extra coarse-GRU-only updates per iter
     mixed_precision: bool = False  # bf16 compute for encoders + update block
+    # Force fp32 features into the correlation backend even under mixed
+    # precision.  The reference forces fp32 for reg/alt (core/raft_stereo.py:
+    # 92,95) but runs its CUDA lookup in fp16; our fused kernels likewise keep
+    # the compute dtype by default (~1e-2 corr drift in bf16).  Set True to
+    # reproduce the reference's fp32 correlation numerics exactly while still
+    # running everything else in bf16.
+    corr_fp32: bool = False
     context_norm: str = "batch"    # cnet norm (reference uses frozen batch norm)
     fnet_norm: str = "instance"
     fnet_dim: int = 256

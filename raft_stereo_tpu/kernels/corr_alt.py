@@ -317,10 +317,16 @@ def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
     wcat = sum(f2.shape[2] for f2 in fmap2_pyramid)
     d = fmap1.shape[-1]
     w2_max = max(f2.shape[2] for f2 in fmap2_pyramid)
+    k = 2 * radius + 1
     fp32 = 4  # the kernel upcasts to fp32 whatever the input dtype
     working_set = (ROW_BLK * wcat * d * fp32          # f2cat upcast
                    + ROW_BLK * W1_BLK * d * fp32      # f1 tile upcast
-                   + ROW_BLK * W1_BLK * w2_max * fp32)  # largest volume tile
+                   + ROW_BLK * W1_BLK * w2_max * fp32  # largest volume tile
+                   # the hat-weight broadcast materializes at volume-tile
+                   # size before the contraction, and the output tile is
+                   # live across all levels
+                   + ROW_BLK * W1_BLK * w2_max * fp32
+                   + ROW_BLK * W1_BLK * len(fmap2_pyramid) * k * fp32)
     if working_set <= _MULTI_VMEM_BUDGET:
         static = (radius,
                   tuple(int(sum(f.shape[2] for f in fmap2_pyramid[:i]))

@@ -189,6 +189,13 @@ def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
     only changes the shard-volume storage dtype.  ``alt`` builds no volume
     and is rejected at config validation.  Activate a mesh with
     ``corr_sharding(mesh)`` during tracing first."""
+    if cfg.corr_fp32:
+        # Reference-exact correlation numerics under mixed precision
+        # (core/raft_stereo.py:92,95 force fp32 for reg/alt): upcast before
+        # backend construction so even the dtype-preserving fused kernels
+        # run fp32.
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
     if cfg.corr_w2_shards > 1:
         from raft_stereo_tpu.parallel.corr_sharded import (
             active_corr_mesh, make_corr_fn_w2_sharded)

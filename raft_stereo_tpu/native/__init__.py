@@ -113,6 +113,14 @@ def read_pfm(path: str) -> np.ndarray:
                       ctypes.byref(c))
     if rc:
         raise ValueError(f"{path}: PFM parse error {rc}")
+    # Sanity-bound the header-declared dims against the payload actually
+    # present before allocating: a corrupt/truncated header could otherwise
+    # declare huge dims and trigger a multi-GB np.empty (MemoryError) instead
+    # of the ValueError that routes callers to the Python fallback.
+    if w.value * h.value * c.value * 4 > len(buf):
+        raise ValueError(
+            f"{path}: PFM header declares {w.value}x{h.value}x{c.value} "
+            f"floats but file holds only {len(buf)} bytes")
     out = np.empty((h.value, w.value, c.value), np.float32)
     rc = lib.pfm_decode(buf, len(buf),
                         out.ctypes.data_as(ctypes.c_void_p))

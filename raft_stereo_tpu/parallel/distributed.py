@@ -32,6 +32,7 @@ import os
 from typing import Dict, Optional
 
 import jax
+import numpy as np
 
 log = logging.getLogger(__name__)
 
@@ -87,6 +88,25 @@ def initialize(coordinator_address: Optional[str] = None,
     log.info("distributed: process %d/%d, %d local of %d global devices",
              jax.process_index(), jax.process_count(),
              jax.local_device_count(), jax.device_count())
+
+
+def any_process(flag: bool) -> bool:
+    """Global OR of a per-process bool.
+
+    This is a COLLECTIVE in multi-process runs — every process must call it
+    the same number of times (the train loop calls it once per step).  It
+    coordinates the preemption stop: a SIGTERM landing on one host (or at
+    different step boundaries on different hosts) must make EVERY process
+    break the loop at the same step, or the processes that kept going would
+    dispatch step collectives while the stopping one enters the collective
+    checkpoint save — distributed deadlock (the maxtext/t5x
+    reached-preemption-sync-point pattern)."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray(flag, np.int32))
+    return bool(np.max(flags))
 
 
 def loader_shard_kwargs() -> Dict[str, int]:

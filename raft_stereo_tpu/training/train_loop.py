@@ -64,6 +64,10 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     n_corr = model_cfg.corr_w2_shards
     if n_corr > 1 and not use_mesh:
         raise ValueError("corr_w2_shards > 1 requires use_mesh=True")
+    if use_mesh and len(devices) < n_corr:
+        raise ValueError(
+            f"corr_w2_shards={n_corr} exceeds the {len(devices)} available "
+            f"devices — no device is left for the data axis")
     n_data = train_cfg.data_parallel or len(devices) // n_corr
     if train_cfg.batch_size % n_data:
         raise ValueError(f"batch_size={train_cfg.batch_size} not divisible "
@@ -160,7 +164,12 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
 
     try:
         for batch in loader:
-            if step >= total or stop_requested:
+            # The stop decision must be GLOBAL: a signal lands on one host
+            # only, and every process has to break at the same step boundary
+            # before the collective checkpoint save (any_process is itself a
+            # collective — called unconditionally once per step; `step` is
+            # identical on all processes so the short-circuit is consistent).
+            if step >= total or distributed.any_process(stop_requested):
                 break
             if mesh is not None:
                 batch = shard_batch(batch, mesh)

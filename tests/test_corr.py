@@ -147,3 +147,26 @@ def test_pyramid_shapes():
     corr = jnp.zeros((1, 4, 10, 37))
     pyr = build_corr_pyramid(corr, 4)
     assert [p.shape[-1] for p in pyr] == [37, 18, 9, 4]
+
+
+def test_corr_fp32_knob_forces_fp32_under_bf16(fmaps):
+    """corr_fp32=True must reproduce fp32 'reg' numerics exactly even when
+    the incoming features are bf16 (the mixed-precision case the knob exists
+    for — reference forces fp32 at core/raft_stereo.py:92,95)."""
+    f1, f2, coords = fmaps
+    f1_bf = jnp.asarray(f1).astype(jnp.bfloat16)
+    f2_bf = jnp.asarray(f2).astype(jnp.bfloat16)
+    # The knob cannot undo the bf16 rounding of the features themselves, so
+    # the golden value is fp32 'reg' compute ON the bf16-rounded features —
+    # any backend that secretly keeps bf16 compute/storage fails the tight
+    # tolerance (bf16 compute drifts ~1e-2 here).
+    want = make_corr_fn(RaftStereoConfig(corr_backend="reg"),
+                        f1_bf.astype(jnp.float32),
+                        f2_bf.astype(jnp.float32))(jnp.asarray(coords))
+    for backend in ("reg", "alt", "reg_fused"):
+        got = make_corr_fn(
+            RaftStereoConfig(corr_backend=backend, mixed_precision=True,
+                             corr_fp32=True), f1_bf, f2_bf)(jnp.asarray(coords))
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)

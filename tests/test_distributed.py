@@ -53,3 +53,8 @@ def test_initialize_single_process_noop():
     distributed.initialize()  # must not raise or hang in 1-process runs
     kw = distributed.loader_shard_kwargs()
     assert kw == {"process_index": 0, "process_count": 1}
+
+
+def test_any_process_single_process_identity():
+    assert distributed.any_process(True) is True
+    assert distributed.any_process(False) is False

@@ -217,3 +217,10 @@ class _SyntheticDataset:
         return {"image1": img, "image2": img,
                 "flow": np.full((32, 64), -2.0, np.float32),
                 "valid": np.ones((32, 64), np.float32)}
+
+
+def test_train_rejects_more_corr_shards_than_devices():
+    from raft_stereo_tpu.training.train_loop import train
+    with pytest.raises(ValueError, match="exceeds"):
+        train(RaftStereoConfig(corr_w2_shards=len(jax.devices()) * 2),
+              TrainConfig(batch_size=2, num_steps=1))
