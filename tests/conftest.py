@@ -42,3 +42,12 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two test tiers (VERDICT round 1 #8): everything not marked ``slow``
+    is auto-marked ``quick``, so ``pytest -m quick`` is the <60s regression
+    smoke and ``pytest -m slow`` the heavy full-model/sharded tier."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.quick)

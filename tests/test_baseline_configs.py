@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-model / subprocess-scale tests
+
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 

@@ -5,6 +5,8 @@ import os
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-model / subprocess-scale tests
 from PIL import Image
 
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig

@@ -73,6 +73,7 @@ def test_pool_last_axis_floor_semantics(rng):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_reg_matches_torch_reference(fmaps):
     f1, f2, coords = fmaps
     cfg = RaftStereoConfig(corr_levels=4, corr_radius=4, corr_backend="reg")
@@ -149,6 +150,7 @@ def test_pyramid_shapes():
     assert [p.shape[-1] for p in pyr] == [37, 18, 9, 4]
 
 
+@pytest.mark.slow
 def test_corr_fp32_knob_forces_fp32_under_bf16(fmaps):
     """corr_fp32=True must reproduce fp32 'reg' numerics exactly even when
     the incoming features are bf16 (the mixed-precision case the knob exists

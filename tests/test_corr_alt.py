@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-model / subprocess-scale tests
+
 from raft_stereo_tpu.config import RaftStereoConfig
 from raft_stereo_tpu.kernels import corr_alt, corr_lookup
 from raft_stereo_tpu.models.corr import make_corr_fn_alt

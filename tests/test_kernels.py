@@ -37,6 +37,7 @@ def test_fused_matches_xla_forward(rng):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_matches_xla_gradient(rng):
     pyr = _pyramid(rng, b=1, h=4, w=32, levels=2)
     b, h, w, _ = pyr[0].shape
@@ -60,6 +61,7 @@ def test_fused_matches_xla_gradient(rng):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_keeps_bf16(rng):
     pyr = [p.astype(jnp.bfloat16) for p in _pyramid(rng, levels=2)]
     b, h, w, _ = pyr[0].shape
@@ -80,6 +82,7 @@ def test_fused_zero_padding(rng):
     np.testing.assert_array_equal(np.asarray(out), 0.0)
 
 
+@pytest.mark.slow
 def test_model_runs_with_fused_backend(rng):
     """End-to-end: reg_fused backend through the full model (interpret)."""
     from raft_stereo_tpu.config import RaftStereoConfig

@@ -21,6 +21,7 @@ def _init_and_run(cfg, B=1, H=64, W=96, iters=3, test_mode=False, seed=0):
     return variables, out
 
 
+@pytest.mark.slow
 def test_train_mode_shapes():
     cfg = RaftStereoConfig()
     _, preds = _init_and_run(cfg, B=2, H=64, W=96, iters=3)
@@ -28,6 +29,7 @@ def test_train_mode_shapes():
     assert np.all(np.isfinite(np.asarray(preds)))
 
 
+@pytest.mark.slow
 def test_test_mode_shapes():
     cfg = RaftStereoConfig()
     _, (disp_low, disp_up) = _init_and_run(cfg, iters=3, test_mode=True)
@@ -35,6 +37,7 @@ def test_test_mode_shapes():
     assert disp_up.shape == (1, 64, 96)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_gru_layers", [1, 2, 3])
 def test_gru_layer_variants(n_gru_layers):
     cfg = RaftStereoConfig(n_gru_layers=n_gru_layers)
@@ -42,6 +45,7 @@ def test_gru_layer_variants(n_gru_layers):
     assert preds.shape == (2, 1, 64, 96)
 
 
+@pytest.mark.slow
 def test_realtime_config():
     """shared_backbone + n_downsample 3 + 2 GRU layers + slow_fast
     (reference: README.md:84)."""
@@ -54,6 +58,7 @@ def test_realtime_config():
     assert np.all(np.isfinite(np.asarray(disp_up)))
 
 
+@pytest.mark.slow
 def test_alt_backend_matches_reg():
     """Backend interchangeability — the reference's core contract
     (core/raft_stereo.py:90-100)."""
@@ -65,6 +70,7 @@ def test_alt_backend_matches_reg():
     np.testing.assert_allclose(out["reg"], out["alt"], rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_flow_init_warm_start():
     cfg = RaftStereoConfig()
     model = RAFTStereo(cfg)
@@ -78,6 +84,7 @@ def test_flow_init_warm_start():
     assert np.abs(np.asarray(disp_low).mean() - (-3.0)) < 3.0
 
 
+@pytest.mark.slow
 def test_gradients_flow():
     cfg = RaftStereoConfig(n_gru_layers=2)
     model = RAFTStereo(cfg)

@@ -94,6 +94,7 @@ class TestPooling:
         got = avg_pool2d(jnp.asarray(x), (5, 5), (4, 4), ((1, 1), (1, 1)))
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_feature_sampler_agrees_with_scalar_sampler(self, rng):
         """linear_sampler_1d_features must stay in sync with
         linear_sampler_1d (same boundary semantics)."""

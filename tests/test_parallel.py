@@ -29,6 +29,7 @@ def _coords(rng, b, h, w1, w2):
     return jnp.asarray(c, jnp.float32)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_corr", [2, 4])
 @pytest.mark.parametrize("w2", [64, 52, 13])
 def test_sharded_matches_reg(rng, n_corr, w2):
@@ -45,6 +46,7 @@ def test_sharded_matches_reg(rng, n_corr, w2):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharded_gradients_match_reg(rng):
     cfg = RaftStereoConfig(corr_w2_shards=2)
     mesh = make_mesh(n_data=4, n_corr=2)
@@ -95,6 +97,7 @@ def test_dispatch_requires_active_mesh(rng):
         make_corr_fn(cfg, f1, f2)
 
 
+@pytest.mark.slow
 def test_full_model_sharded_matches_unsharded(rng):
     """Whole-model forward with corr_w2_shards=2 ≡ the plain reg model."""
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo

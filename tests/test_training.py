@@ -91,6 +91,7 @@ def _tiny_batch(rng, b=8, h=32, w=64):
     }
 
 
+@pytest.mark.slow
 def test_train_step_single_device(rng):
     mcfg = RaftStereoConfig(n_gru_layers=2, hidden_dims=(64, 64))
     tcfg = TrainConfig(train_iters=2, num_steps=100)
@@ -108,6 +109,7 @@ def test_train_step_single_device(rng):
     assert max(jax.tree_util.tree_leaves(diff)) > 0
 
 
+@pytest.mark.slow
 def test_train_step_sharded_matches_single(rng):
     """SPMD data-parallel step over an 8-device mesh produces the same
     update as the single-device step (the DataParallel-equivalence
@@ -137,6 +139,7 @@ def test_train_step_sharded_matches_single(rng):
 
 
 # --------------------------------------------------------------- checkpoint
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path, rng):
     from raft_stereo_tpu.training.checkpoint import (load_checkpoint,
                                                      load_weights,
@@ -164,6 +167,7 @@ def test_checkpoint_roundtrip(tmp_path, rng):
     assert "params" in variables
 
 
+@pytest.mark.slow
 def test_sigterm_checkpoints_and_resumes(tmp_path, rng):
     """Preemption safety: SIGTERM mid-training stops at the next step
     boundary with a resumable full-state checkpoint."""
