@@ -1,0 +1,175 @@
+"""Synthetic-but-realistic benchmark mini-trees for offline eval parity.
+
+The environment has no network egress (see BASELINE.md), so the published
+checkpoints and benchmark datasets cannot be fetched.  This module builds
+miniature versions of the four evaluation benchmarks in the EXACT on-disk
+layouts the reference globs (reference: core/stereo_datasets.py:185-274),
+with textured stereo pairs where the right view is a true horizontal warp of
+the left by a known disparity field — so both the reference's
+``evaluate_stereo.py`` validators and ours can run end-to-end on identical
+bytes and their EPE/D1 numbers can be compared exactly.
+
+Images are multi-scale filtered noise (not flat randomness) so feature
+encoders see realistic local structure; disparity is a smooth ramp plus
+foreground rectangles (depth discontinuities), with each benchmark's native
+invalid-pixel encoding (inf PFM values, zero KITTI png, Middlebury nocc
+mask).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from raft_stereo_tpu.data import frame_utils
+
+
+def textured_image(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    """Multi-octave smooth noise -> (H, W, 3) uint8 with local structure."""
+    acc = np.zeros((h, w), np.float32)
+    for period in (4, 8, 16, 32):
+        gh, gw = h // period + 2, w // period + 2
+        grid = rng.standard_normal((gh, gw)).astype(np.float32)
+        up = Image.fromarray(grid).resize((w, h), Image.BILINEAR)
+        acc += period * np.asarray(up, np.float32)
+    acc = (acc - acc.min()) / (acc.max() - acc.min() + 1e-9)
+    r = (acc * 255).astype(np.uint8)
+    g = np.roll(r, 3, axis=1)
+    b = np.roll(r, 3, axis=0)
+    return np.stack([r, g, b], axis=-1)
+
+
+def disparity_field(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    """Smooth ramp + foreground rectangles, positive, max ~12 px."""
+    y, x = np.mgrid[0:h, 0:w].astype(np.float32)
+    disp = 3.0 + 4.0 * x / w + 1.5 * np.sin(2 * np.pi * y / h)
+    for _ in range(2):
+        y0 = int(rng.integers(0, h // 2))
+        x0 = int(rng.integers(0, w // 2))
+        hh = int(rng.integers(h // 6, h // 3))
+        ww = int(rng.integers(w // 6, w // 3))
+        disp[y0:y0 + hh, x0:x0 + ww] += float(rng.uniform(2.0, 5.0))
+    return disp.astype(np.float32)
+
+
+def warp_right(left: np.ndarray, disp: np.ndarray) -> np.ndarray:
+    """right[y, x] = left[y, x + disp[y, x]] per-row linear interpolation —
+    the stereo geometry (matching left pixel sits ``disp`` to the RIGHT of
+    the right-image pixel)."""
+    h, w, _ = left.shape
+    xs = np.arange(w, dtype=np.float32)
+    out = np.empty_like(left)
+    for yy in range(h):
+        src = xs + disp[yy]
+        for c in range(3):
+            out[yy, :, c] = np.interp(src, xs, left[yy, :, c].astype(np.float32))
+    return out.astype(np.uint8)
+
+
+def _pair(rng, h, w):
+    left = textured_image(rng, h, w)
+    disp = disparity_field(rng, h, w)
+    right = warp_right(left, disp)
+    return left, right, disp
+
+
+def make_eth3d(root: str, rng, n: int = 2, hw=(60, 90)) -> None:
+    """two_view_training/<scene>/im{0,1}.png + two_view_training_gt/<scene>/
+    disp0GT.pfm; invalid pixels are +inf (reference: stereo_datasets.py:185-195,
+    valid = disp < 512 via the non-tuple reader path)."""
+    h, w = hw
+    for i in range(n):
+        scene = os.path.join(root, "two_view_training", f"scene_{i}")
+        gt = os.path.join(root, "two_view_training_gt", f"scene_{i}")
+        os.makedirs(scene), os.makedirs(gt)
+        left, right, disp = _pair(rng, h, w)
+        Image.fromarray(left).save(os.path.join(scene, "im0.png"))
+        Image.fromarray(right).save(os.path.join(scene, "im1.png"))
+        disp = disp.copy()
+        disp[rng.random((h, w)) < 0.05] = np.inf  # ETH3D invalid encoding
+        frame_utils.write_pfm(os.path.join(gt, "disp0GT.pfm"), disp)
+
+
+def make_kitti(root: str, rng, n: int = 2, hw=(60, 90)) -> None:
+    """training/{image_2,image_3,disp_occ_0}/<id>_10.png; sparse 16-bit
+    disparity/256, zero = invalid (reference: stereo_datasets.py:246-257,
+    frame_utils.py:124-127)."""
+    h, w = hw
+    for sub in ("image_2", "image_3", "disp_occ_0"):
+        os.makedirs(os.path.join(root, "training", sub))
+    for i in range(n):
+        left, right, disp = _pair(rng, h, w)
+        Image.fromarray(left).save(
+            os.path.join(root, "training", "image_2", f"{i:06d}_10.png"))
+        Image.fromarray(right).save(
+            os.path.join(root, "training", "image_3", f"{i:06d}_10.png"))
+        disp = disp.copy()
+        disp[rng.random((h, w)) < 0.4] = 0.0  # sparse: ~60% coverage
+        frame_utils.write_disp_kitti(
+            os.path.join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
+            disp)
+
+
+def make_things(root: str, rng, n: int = 2, hw=(60, 90),
+                dstype: str = "frames_finalpass") -> None:
+    """FlyingThings3D/<dstype>/TEST/A/<seq>/left|right/0006.png +
+    disparity pfm.  With fewer than 400 files the seed-1000 validation
+    subset selects ALL of them in both frameworks
+    (reference: stereo_datasets.py:145-149)."""
+    h, w = hw
+    for i in range(n):
+        seq = os.path.join(root, "FlyingThings3D", dstype, "TEST", "A",
+                           f"{i:04d}")
+        dseq = os.path.join(root, "FlyingThings3D", "disparity", "TEST", "A",
+                            f"{i:04d}", "left")
+        os.makedirs(os.path.join(seq, "left"))
+        os.makedirs(os.path.join(seq, "right"))
+        os.makedirs(dseq)
+        left, right, disp = _pair(rng, h, w)
+        Image.fromarray(left).save(os.path.join(seq, "left", "0006.png"))
+        Image.fromarray(right).save(os.path.join(seq, "right", "0006.png"))
+        frame_utils.write_pfm(os.path.join(dseq, "0006.pfm"), disp)
+
+
+def make_middlebury(root: str, rng, n: int = 2, hw=(60, 90),
+                    split: str = "H") -> None:
+    """MiddEval3/training<split>/<scene>/{im0,im1,disp0GT.pfm,mask0nocc.png}
+    + the trainingF listing and official_train.txt filter the reference
+    applies (reference: stereo_datasets.py:260-274); unknown GT is +inf,
+    nocc mask 255 = non-occluded, 128 = occluded."""
+    h, w = hw
+    names = []
+    for i in range(n):
+        name = f"Scene{i}"
+        names.append(name)
+        scene = os.path.join(root, "MiddEval3", f"training{split}", name)
+        os.makedirs(scene)
+        # the reference enumerates trainingF to list scene names
+        os.makedirs(os.path.join(root, "MiddEval3", "trainingF", name),
+                    exist_ok=True)
+        left, right, disp = _pair(rng, h, w)
+        Image.fromarray(left).save(os.path.join(scene, "im0.png"))
+        Image.fromarray(right).save(os.path.join(scene, "im1.png"))
+        disp = disp.copy()
+        disp[rng.random((h, w)) < 0.04] = np.inf  # unknown GT
+        frame_utils.write_pfm(os.path.join(scene, "disp0GT.pfm"), disp)
+        mask = np.where(rng.random((h, w)) < 0.2, 128, 255).astype(np.uint8)
+        Image.fromarray(mask).save(os.path.join(scene, "mask0nocc.png"))
+    with open(os.path.join(root, "MiddEval3", "official_train.txt"),
+              "w") as f:
+        f.write("\n".join(names) + "\n")
+
+
+def make_all_benchmarks(datasets_root: str, seed: int = 7) -> str:
+    """Build all four mini-benchmarks under ``datasets_root`` (the directory
+    the reference's relative default roots resolve against when it is the
+    CWD).  Returns ``datasets_root``."""
+    rng = np.random.default_rng(seed)
+    make_eth3d(os.path.join(datasets_root, "datasets", "ETH3D"), rng)
+    make_kitti(os.path.join(datasets_root, "datasets", "KITTI"), rng)
+    make_things(os.path.join(datasets_root, "datasets"), rng)
+    make_middlebury(os.path.join(datasets_root, "datasets", "Middlebury"),
+                    rng)
+    return datasets_root

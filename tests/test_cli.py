@@ -5,14 +5,14 @@ import os
 
 import numpy as np
 import pytest
-
-pytestmark = pytest.mark.slow  # full-model / subprocess-scale tests
 from PIL import Image
 
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
 from raft_stereo_tpu.data import frame_utils
 from raft_stereo_tpu.data.datasets import KITTI
 from raft_stereo_tpu.data.loader import StereoLoader
+
+pytestmark = pytest.mark.slow  # full-model / subprocess-scale tests
 
 TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64)  # fast CPU compiles
 
