@@ -67,6 +67,15 @@ class RaftStereoConfig:
     context_norm: str = "batch"    # cnet norm (reference uses frozen batch norm)
     fnet_norm: str = "instance"
     fnet_dim: int = 256
+    # Rematerialize the GRU scan body in the backward pass (train mode only;
+    # ``jax.checkpoint``).  Training stores per-iteration activations of
+    # every conv in the update block otherwise — ~0.6 GB x train_iters at the
+    # reference's SceneFlow config (batch 8, 320x720), which overflows a
+    # single 16 GB chip.  With remat only the scan carries persist and the
+    # backward recomputes each iteration's internals (~1/3 more FLOPs for
+    # ~10x less activation memory).  Turn off when per-device batch is small
+    # enough (e.g. data-parallel over many chips) to trade memory for speed.
+    remat_gru: bool = True
     # Extension beyond the reference: shard the W2 (disparity-search) axis of
     # the correlation volume across a mesh axis for full-res inputs.
     corr_w2_shards: int = 1

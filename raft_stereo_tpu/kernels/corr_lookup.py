@@ -60,26 +60,37 @@ _interpret = interpret_enabled  # internal alias
 # The hat-function formulation (module docstring) shared by this kernel and
 # the fused no-volume kernel (kernels/corr_alt.py) — one implementation so
 # boundary/interpolation semantics can never diverge between them.
+def _hat_field(centers, w2: int, radius: int):
+    """Shared per-tap weights: tap k's weight at bin x is
+    ``max(0, 1-|x - centers - (k-radius)|)`` = F[x + 2·radius - k] where
+    F[j] = max(0, 1-|j - radius - centers|) over j ∈ [0, w2+2·radius).
+    Computing F ONCE and slicing per tap replaces ~6 vector passes per tap
+    (iota, sub, abs, sub, max, mul) with 2 (mul, add) — the training-trace
+    finding that the VPU weight construction, not DMA or launch overhead,
+    dominates the lookup (docs/TRAIN_PROFILE.md)."""
+    ext = w2 + 2 * radius
+    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, ext), 2).astype(jnp.float32)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(xs - radius - centers[..., None]))
+
+
 def hat_sample(v, centers, radius: int):
     """Σ_x v[..., x] · hat_k(x) for each tap k: (R, W1B, W2) tile +
     (R, W1B) centers → per-tap sampler yielding (R, W1B) slices."""
     w2 = v.shape[-1]
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    f = _hat_field(centers, w2, radius)
     for k in range(2 * radius + 1):
-        pos = centers + (k - radius)                  # (R, W1B)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        yield k, jnp.sum(v * w, axis=-1)
+        off = 2 * radius - k
+        yield k, jnp.sum(v * f[:, :, off:off + w2], axis=-1)
 
 
 def hat_scatter(g, centers, w2: int, radius: int):
     """Transpose of :func:`hat_sample`: (R, W1B, K) cotangent + centers
     → (R, W1B, W2) volume cotangent."""
-    xs = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2).astype(jnp.float32)
+    f = _hat_field(centers, w2, radius)
     acc = jnp.zeros(centers.shape + (w2,), jnp.float32)
     for k in range(2 * radius + 1):
-        pos = centers + (k - radius)
-        w = jnp.maximum(0.0, 1.0 - jnp.abs(xs - pos[..., None]))
-        acc = acc + g[:, :, k][..., None] * w
+        off = 2 * radius - k
+        acc = acc + g[:, :, k][..., None] * f[:, :, off:off + w2]
     return acc
 
 
@@ -174,10 +185,140 @@ def _sample_level_bwd(radius, scale, residuals, g):
 _sample_level.defvjp(_sample_level_fwd, _sample_level_bwd)
 
 
+# ----------------------------------------- single-launch all-levels lookup
+# Training-trace finding (docs/TRAIN_PROFILE.md): each custom call inside the
+# 22-iteration scan carries ~1 ms of in-graph overhead/stall far above its
+# isolated runtime (26 us), so 12 per-iteration launches (4 fwd + 4 remat
+# recompute + 4 bwd) dominate the step.  Sampling EVERY level in one launch
+# (and all level cotangents in one backward launch) cuts that to 3.  The
+# levels stay separate pallas_call operands — no concatenated-volume copy.
+
+def _fwd_kernel_multi(*refs, radius: int, levels: int):
+    coords = refs[levels][:].astype(jnp.float32)
+    out_ref = refs[levels + 1]
+    k = 2 * radius + 1
+    for i in range(levels):
+        vol = refs[i][:].astype(jnp.float32)
+        centers = coords * (1.0 / (2 ** i))
+        for kk, sample in hat_sample(vol, centers, radius):
+            out_ref[:, :, i * k + kk] = sample.astype(out_ref.dtype)
+
+
+def _bwd_kernel_multi(coords_ref, g_ref, *dvol_refs, radius: int,
+                      levels: int):
+    coords = coords_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    k = 2 * radius + 1
+    for i in range(levels):
+        centers = coords * (1.0 / (2 ** i))
+        dvol = hat_scatter(g[:, :, i * k:(i + 1) * k], centers,
+                           dvol_refs[i].shape[-1], radius)
+        dvol_refs[i][:] = dvol.astype(dvol_refs[i].dtype)
+
+
+def _launch_fwd_multi(vols, coords, radius: int):
+    rows, w1 = coords.shape
+    levels = len(vols)
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_multi, radius=radius, levels=levels),
+        grid=grid,
+        in_specs=[pl.BlockSpec((ROW_BLK, W1_BLK, v.shape[-1]),
+                               lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM) for v in vols]
+                 + [pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                                 memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, levels * k),
+                               lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, levels * k),
+                                       vols[0].dtype),
+        interpret=_interpret(),
+    )(*vols, coords)
+
+
+def _launch_bwd_multi(coords, g, w2s, radius: int, dtype):
+    rows, w1 = coords.shape
+    levels = len(w2s)
+    k = 2 * radius + 1
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel_multi, radius=radius, levels=levels),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK, levels * k), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((ROW_BLK, W1_BLK, w2), lambda i, j: (i, j, 0),
+                                memory_space=pltpu.VMEM) for w2 in w2s],
+        out_shape=[jax.ShapeDtypeStruct((rows, w1, w2), dtype)
+                   for w2 in w2s],
+        interpret=_interpret(),
+    )(coords, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sample_pyramid(vols, coords, radius: int):
+    """Tuple of (B,H,W1,W2_i) volumes + (B,H,W1) centers →
+    (B,H,W1,levels·(2r+1)) window samples, concat level-major."""
+    b, h, w1, _ = vols[0].shape
+    out = _launch_fwd_multi([v.reshape(b * h, w1, v.shape[-1]) for v in vols],
+                            coords.reshape(b * h, w1), radius)
+    return out.reshape(b, h, w1, -1)
+
+
+def _sample_pyramid_fwd(vols, coords, radius):
+    # volumes ride along for static shape/dtype only; values unused in bwd
+    return _sample_pyramid(vols, coords, radius), (vols, coords)
+
+
+def _sample_pyramid_bwd(radius, residuals, g):
+    vols, coords = residuals
+    b, h, w1, _ = vols[0].shape
+    dvols = _launch_bwd_multi(coords.reshape(b * h, w1),
+                              g.reshape(b * h, w1, -1),
+                              [v.shape[-1] for v in vols], radius,
+                              vols[0].dtype)
+    return (tuple(d.reshape(b, h, w1, -1) for d in dvols),
+            jnp.zeros_like(coords))
+
+
+_sample_pyramid.defvjp(_sample_pyramid_fwd, _sample_pyramid_bwd)
+
+
+# Conservative per-program VMEM budget for the single-launch path (the
+# sibling alt kernel gates on the same number — kernels/corr_alt.py).
+_MULTI_VMEM_BUDGET = 10 * 2 ** 20
+
+
+def _multi_working_set(w2s, radius: int, itemsize: int) -> int:
+    """Bytes one program of ``_fwd_kernel_multi`` holds live: per level the
+    input tile, its fp32 upcast, and the (w2+2r)-wide fp32 hat field; plus
+    the all-levels output tile."""
+    fp32 = 4
+    k = 2 * radius + 1
+    per_level = sum(
+        ROW_BLK * W1_BLK * (w2 * (itemsize + fp32) + (w2 + 2 * radius) * fp32)
+        for w2 in w2s)
+    return per_level + ROW_BLK * W1_BLK * len(w2s) * k * fp32
+
+
 def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
                          radius: int) -> jnp.ndarray:
     """Fused window lookup at every pyramid level, concat level-major —
-    drop-in replacement for ``lookup_pyramid_xla`` (models/corr.py)."""
+    drop-in replacement for ``lookup_pyramid_xla`` (models/corr.py).
+
+    Uses the single-launch all-levels kernel when every level's tile fits
+    the per-program VMEM budget together; otherwise one launch per level
+    (full-resolution volumes grow ~linearly in W2 and must not turn a
+    previously-working eval into a Mosaic VMEM compile failure)."""
+    w2s = [v.shape[-1] for v in pyramid]
+    if (len(pyramid) > 1 and _multi_working_set(
+            w2s, radius, pyramid[0].dtype.itemsize) <= _MULTI_VMEM_BUDGET):
+        return _sample_pyramid(tuple(pyramid), coords, radius)
     outs = [_sample_level(vol, coords, radius, 1.0 / (2 ** i))
             for i, vol in enumerate(pyramid)]
     return jnp.concatenate(outs, axis=-1)

@@ -163,6 +163,11 @@ class RAFTStereo(nn.Module):
             flow_up = module._upsample(disp, up_mask)
             return (tuple(net_list), disp), flow_up
 
+        if cfg.remat_gru:
+            # Backward recomputes each iteration from its carry instead of
+            # storing every update-block activation (see config.remat_gru).
+            # prevent_cse=False is safe (and recommended) under scan.
+            body_train = nn.remat(body_train, prevent_cse=False)
         scan_train = nn.scan(body_train, variable_broadcast=("params", "batch_stats"),
                              split_rngs={"params": False}, length=iters)
         (net_fin, disp_fin), flow_ups = scan_train(

@@ -1,0 +1,91 @@
+"""Summarize a jax.profiler trace: top device ops by self time.
+
+Usage:  python tools/trace_summary.py <trace_dir> [--top N]
+
+Reads the ``*.xplane.pb`` written by ``raft_stereo_tpu.profiling.trace``
+(TensorBoard's profile plugin format) and aggregates XLA-op event durations
+on the device planes — the data behind TensorBoard's op-profile view,
+without needing TensorBoard.  Events nested under other events on the same
+line are charged only once (self time = duration minus nested children).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+
+
+def _load_xplane(trace_dir: str):
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # env-provided
+
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def device_op_times(trace_dir: str):
+    """{op_display_name: self_seconds} across TPU/device planes."""
+    space = _load_xplane(trace_dir)
+    totals: dict = collections.defaultdict(float)
+    for plane in space.planes:
+        if not re.search(r"TPU|/device:|GPU", plane.name):
+            continue
+        if "XLA Modules" in plane.name or "Steps" in plane.name:
+            continue
+        emeta = plane.event_metadata
+        for line in plane.lines:
+            # the per-op line; module/step/framework lines double-count
+            if line.name and line.name != "XLA Ops":
+                continue
+            # events on one line can nest (fusion > sub-op); compute self
+            # time by subtracting enclosed children
+            evs = sorted(line.events,
+                         key=lambda e: (e.offset_ps, -e.duration_ps))
+            stack = []  # (end_ps, index into out)
+            out = []
+            for e in evs:
+                start, dur = e.offset_ps, e.duration_ps
+                while stack and start >= stack[-1][0]:
+                    stack.pop()
+                if stack:
+                    out[stack[-1][1]][1] -= dur  # child: subtract from parent
+                name = emeta[e.metadata_id].name if e.metadata_id in emeta \
+                    else str(e.metadata_id)
+                out.append([name, dur])
+                stack.append((start + dur, len(out) - 1))
+            for name, self_ps in out:
+                totals[name] += max(self_ps, 0) / 1e12
+    return dict(totals)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    totals = device_op_times(args.trace_dir)
+    total = sum(totals.values())
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:args.top]
+    if args.json:
+        print(json.dumps({"total_s": total, "top": [
+            {"op": k, "self_s": round(v, 6), "pct": round(100 * v / total, 2)}
+            for k, v in ranked]}))
+        return
+    print(f"device total: {total * 1e3:.2f} ms")
+    for k, v in ranked:
+        print(f"{100 * v / total:6.2f}%  {v * 1e3:9.3f} ms  {k}")
+
+
+if __name__ == "__main__":
+    main()
