@@ -183,11 +183,11 @@ def make_corr_fn(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
     """Dispatch on ``cfg.corr_backend`` (≙ core/raft_stereo.py:90-100).
 
     ``corr_w2_shards > 1`` routes to the disparity-axis-sharded volume
-    (parallel/corr_sharded.py) for ``reg`` and ``reg_fused`` — both use the
-    XLA sampler per shard (jax cannot yet vma-check the Pallas primitive
-    inside a partial-manual shard_map; see corr_sharded.py); ``reg_fused``
-    only changes the shard-volume storage dtype.  ``alt`` builds no volume
-    and is rejected at config validation.  Activate a mesh with
+    (parallel/corr_sharded.py): ``reg_fused`` samples each shard with the
+    Pallas kernel (full-manual shard_map, shard-shifted centers) and also
+    stores shard volumes in the compute dtype; ``reg`` keeps the XLA
+    sampler as the pure-XLA correctness reference.  ``alt`` builds no
+    volume and is rejected at config validation.  Activate a mesh with
     ``corr_sharding(mesh)`` during tracing first."""
     if cfg.corr_fp32:
         # Reference-exact correlation numerics under mixed precision

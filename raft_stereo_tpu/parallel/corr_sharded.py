@@ -137,33 +137,73 @@ def make_corr_fn_w2_sharded(cfg: RaftStereoConfig, fmap1: jnp.ndarray,
                         for _ in range(num_levels)),
     )(fmap1, fmap2)
 
-    # The per-shard lookup uses the XLA sampler even for the reg_fused
-    # backend: the Pallas primitive carries no varying-axes annotation, so
-    # jax 0.9's partial-manual shard_map cannot vma-check it, and the
-    # check_vma=False escape hatch mis-validates out_specs in partial-manual
-    # mode (it reports the auto axis as referenced).  When either is fixed
-    # upstream, dispatch to kernels.corr_lookup._sample_level with
-    # shard-shifted coordinates here — the kernel math already supports it
-    # (out-of-shard taps get zero hat weights).
-    def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
-                     ) -> jnp.ndarray:
-        shard = lax.axis_index(CORR_AXIS)
-        outs = []
-        for level, vol in enumerate(pyr):
-            offset = (shard * vol.shape[-1]).astype(coords.dtype)
-            taps = _window_coords(coords, level, radius) - offset
-            outs.append(linear_sampler_1d(vol.astype(jnp.float32), taps))
-        # Each global bin is owned by exactly one shard; out-of-shard taps
-        # contributed zero, so the cross-shard sum IS the global interpolated
-        # window.
-        return lax.psum(jnp.concatenate(outs, axis=-1), CORR_AXIS)
+    # Per-shard lookup.  Two implementations of the same contract:
+    #
+    # * reg_fused → the Pallas kernel with shard-shifted centers, inside a
+    #   FULL-manual shard_map (every mesh axis manual, check_vma=False —
+    #   partial-manual cannot vma-check the Pallas primitive, and full-manual
+    #   is the standard pallas+shard_map pattern).  Out-of-shard taps get
+    #   zero hat weights, so the psum assembles the exact global window.
+    # * reg → the XLA sampler in a partial-manual shard_map (batch axis
+    #   automatic) — the pure-XLA correctness reference, exactly like the
+    #   unsharded backend split.
+    from raft_stereo_tpu.kernels import corr_lookup as _kernels
 
-    lookup = jax.shard_map(
-        lookup_local, mesh=mesh, axis_names={CORR_AXIS},
-        in_specs=(tuple(P(None, None, None, CORR_AXIS)
-                        for _ in range(num_levels)), P()),
-        out_specs=P(),
-    )
+    use_kernel = (cfg.corr_backend == "reg_fused"
+                  and _kernels.fused_lookup_available())
+
+    if use_kernel:
+        # Full-manual requires explicit batch placement: split over the data
+        # axis when the static batch divides it (the training/eval case),
+        # else replicate (e.g. batch-1 init under a multi-device mesh).
+        from raft_stereo_tpu.parallel.mesh import DATA_AXIS
+        n_data = int(mesh.shape.get(DATA_AXIS, 1))
+        split = (DATA_AXIS in mesh.axis_names and n_data > 1
+                 and fmap1.shape[0] % n_data == 0)
+        bspec = DATA_AXIS if split else None
+
+        def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
+                         ) -> jnp.ndarray:
+            # One shifted coordinate serves every level: level i's local
+            # center is (coords - shard·lw_0)/2^i = coords/2^i - shard·lw_i
+            # exactly (lw_i = lw_0/2^i by the padding quantum; scaling by a
+            # power of two is fp-exact), so the whole pyramid samples in the
+            # SINGLE multi-level launch (VMEM-gated) — not one launch per
+            # level, which would reintroduce the per-custom-call overhead
+            # docs/TRAIN_PROFILE.md measured.
+            shard = lax.axis_index(CORR_AXIS)
+            offset = (shard * pyr[0].shape[-1]).astype(coords.dtype)
+            out = _kernels.lookup_pyramid_fused(list(pyr), coords - offset,
+                                                radius)
+            return lax.psum(out.astype(jnp.float32), CORR_AXIS)
+
+        lookup = jax.shard_map(
+            lookup_local, mesh=mesh, axis_names=set(mesh.axis_names),
+            in_specs=(tuple(P(bspec, None, None, CORR_AXIS)
+                            for _ in range(num_levels)), P(bspec)),
+            out_specs=P(bspec),
+            check_vma=False,
+        )
+    else:
+        def lookup_local(pyr: Tuple[jnp.ndarray, ...], coords: jnp.ndarray
+                         ) -> jnp.ndarray:
+            shard = lax.axis_index(CORR_AXIS)
+            outs = []
+            for level, vol in enumerate(pyr):
+                offset = (shard * vol.shape[-1]).astype(coords.dtype)
+                taps = _window_coords(coords, level, radius) - offset
+                outs.append(linear_sampler_1d(vol.astype(jnp.float32), taps))
+            # Each global bin is owned by exactly one shard; out-of-shard
+            # taps contributed zero, so the cross-shard sum IS the global
+            # interpolated window.
+            return lax.psum(jnp.concatenate(outs, axis=-1), CORR_AXIS)
+
+        lookup = jax.shard_map(
+            lookup_local, mesh=mesh, axis_names={CORR_AXIS},
+            in_specs=(tuple(P(None, None, None, CORR_AXIS)
+                            for _ in range(num_levels)), P()),
+            out_specs=P(),
+        )
 
     def corr_fn(coords: jnp.ndarray) -> jnp.ndarray:
         return lookup(pyramid, coords.astype(jnp.float32))
