@@ -1,0 +1,97 @@
+"""Benchmark: full-resolution (Middlebury-F class) inference — the
+long-context path.
+
+BASELINE config 3: the reference runs Middlebury-F full resolution ONLY via
+its no-volume "alt" backend (reference: README.md:121, core/corr.py:64-107)
+because the reg corr volume is O(H·W·W) memory.  This measures, on one chip,
+for the accuracy architecture (n_downsample=2, fp32, 32 iters):
+
+* XLA-compiled peak HBM (``compiled.memory_analysis()`` — this runtime does
+  not expose live device memory stats) for the fused no-volume ``alt``
+  backend vs the volume-based ``reg_fused`` backend;
+* FPS via the chained-differencing protocol (see bench.py), when the
+  program fits at all.
+
+Sizes: 1088x1984 (mid-size MiddEval3-F frames, /32-aligned) and 1984x2880
+(Jadeplant-class, the largest trainingF frames).  Prints one JSON line per
+(backend, size) with peak HBM and FPS; RESOURCE_EXHAUSTED is reported as
+``"oom": true`` — that outcome IS the measurement for the volume path.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SIZES = ((1088, 1984), (1984, 2880))
+BACKENDS = ("alt", "reg_fused")
+ITERS = 32
+K_LO, K_HI = 1, 3
+REPEATS = 3
+
+
+def main():
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.profiling import chained_seconds_per_call
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    rng = np.random.default_rng(0)
+    results = []
+    variables = None
+    for backend in BACKENDS:
+        cfg = RaftStereoConfig(corr_backend=backend)
+        model = RAFTStereo(cfg)
+        if variables is None:
+            img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
+            variables = jax.jit(
+                lambda r: model.init(r, img_s, img_s, iters=1, test_mode=True)
+            )(jax.random.PRNGKey(0))
+        for h, w in SIZES:
+            img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+            img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+
+            @functools.partial(jax.jit, static_argnums=(3,))
+            def chain(variables, image1, image2, k):
+                def body(i, acc):
+                    _, up = model.apply(variables, image1 + i * 1e-6, image2,
+                                        iters=ITERS, test_mode=True)
+                    return acc + jnp.mean(up)
+                return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+            rec = {"metric": "fullres_inference", "backend": backend,
+                   "size": f"{h}x{w}", "iters": ITERS}
+            try:
+                compiled = chain.lower(variables, img1, img2, 1).compile()
+                ma = compiled.memory_analysis()
+                rec["peak_hbm_gib"] = round(
+                    ma.peak_memory_in_bytes / 2 ** 30, 3)
+                rec["temp_gib"] = round(ma.temp_size_in_bytes / 2 ** 30, 3)
+
+                def make_chain(k):
+                    return lambda: float(chain(variables, img1, img2, k))
+
+                per_image = chained_seconds_per_call(
+                    make_chain, k_lo=K_LO, k_hi=K_HI, repeats=REPEATS)
+                rec["value"] = round(1.0 / per_image, 3)
+                rec["unit"] = "frames/s"
+                rec["oom"] = False
+            except Exception as e:  # noqa: BLE001 - OOM is a result here
+                msg = str(e)
+                rec["oom"] = ("RESOURCE_EXHAUSTED" in msg
+                              or "Out of memory" in msg
+                              or "exceeds the limit" in msg)
+                rec["error"] = msg.splitlines()[0][:200]
+            print(json.dumps(rec))
+            results.append(rec)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -45,9 +45,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_stereo_tpu.kernels.corr_lookup import (ROW_BLK, W1_BLK,
+from raft_stereo_tpu.kernels.corr_lookup import (ROW_BLK, VMEM_BUDGET,
+                                                 W1_BLK,
                                                  fused_lookup_available,
                                                  hat_sample, hat_scatter,
+                                                 row_blk_for,
                                                  interpret_enabled as
                                                  _interpret)
 
@@ -67,7 +69,7 @@ def _fwd_kernel(f1_ref, f2_ref, coords_ref, out_ref, *, radius: int,
     v = jax.lax.dot_general(f1, f2, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32,
                             precision=precision) * inv_sqrt_d
-    centers = coords_ref[:].astype(jnp.float32) * scale
+    centers = coords_ref[:, :, 0].astype(jnp.float32) * scale
     for k, sample in hat_sample(v, centers, radius):
         out_ref[:, :, k] = sample.astype(out_ref.dtype)
 
@@ -91,7 +93,7 @@ def _bwd_kernel(f1_ref, f2_ref, coords_ref, g_ref, df1_ref, df2_ref, *,
     f2 = f2_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)          # (R, W1B, K)
     w2 = f2_ref.shape[1]
-    centers = coords_ref[:].astype(jnp.float32) * scale
+    centers = coords_ref[:, :, 0].astype(jnp.float32) * scale
     dv = hat_scatter(g, centers, w2, radius)   # (R, W1B, W2)
     r_blk, w1_blk = centers.shape
     row_idx = (pl.program_id(0) * r_blk
@@ -129,10 +131,25 @@ def _precision_for(dtype) -> jax.lax.Precision:
             else jax.lax.Precision.DEFAULT)
 
 
+# Mosaic fails to compile (not fall back) when a program's live set exceeds
+# VMEM, and at Middlebury-F scale (w2=496, d=256) the default ROW_BLK=8
+# working set is ~12 MB before double buffering — so large shapes shrink the
+# row block via the package-shared budget (corr_lookup.row_blk_for).
+def _fwd_row_bytes(w1_blk, w2, d, itemsize, radius):
+    fp32 = 4
+    return (w2 * d * (itemsize + fp32)          # f2 rows: input + upcast
+            + w1_blk * d * (itemsize + fp32)    # f1 tile: input + upcast
+            + w1_blk * w2 * fp32                # volume tile
+            + w1_blk * (w2 + 2 * radius) * fp32  # hat field
+            + w1_blk * w2 * fp32)               # product intermediate
+
+
 def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
     rows, w1, d = f1.shape
     w2 = f2.shape[1]
     k = 2 * radius + 1
+    ROW_BLK = row_blk_for(_fwd_row_bytes(W1_BLK, w2, d, f1.dtype.itemsize,
+                                      radius))
     grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, radius=radius, scale=scale,
@@ -144,20 +161,26 @@ def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+            pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, w1, k), f1.dtype),
         interpret=_interpret(),
-    )(f1, f2, coords)
+    )(f1, f2, coords[..., None])
 
 
 def _launch_bwd(f1, f2, coords, g, radius, scale, inv_sqrt_d):
     rows, w1, d = f1.shape
     w2 = f2.shape[1]
     k = 2 * radius + 1
+    fp32 = 4
+    ROW_BLK = row_blk_for(
+        _fwd_row_bytes(W1_BLK, w2, d, f1.dtype.itemsize, radius)
+        + W1_BLK * d * fp32    # df1 tile
+        + w2 * d * fp32        # df2 accumulator tile
+        + W1_BLK * w2 * fp32)  # dv tile
     grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_bwd_kernel, radius=radius, scale=scale,
@@ -169,7 +192,7 @@ def _launch_bwd(f1, f2, coords, g, radius, scale, inv_sqrt_d):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+            pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -185,7 +208,7 @@ def _launch_bwd(f1, f2, coords, g, radius, scale, inv_sqrt_d):
             jax.ShapeDtypeStruct((rows, w2, d), f2.dtype),
         ],
         interpret=_interpret(),
-    )(f1, f2, coords, g)
+    )(f1, f2, coords[..., None], g)
 
 
 # -------------------------------------------------------------- level entry
@@ -242,11 +265,6 @@ def _fwd_multi_kernel(f1_ref, f2cat_ref, coords_ref, out_ref, *, radius: int,
             out_ref[:, :, lvl * k + kk] = sample.astype(out_ref.dtype)
 
 
-# Per-tile VMEM budget for the single-launch kernel's fp32 working set
-# (f2cat upcast + f1 tile + the largest per-level volume tile).  The kernel
-# computes in fp32 REGARDLESS of input dtype, so the guard measures fp32
-# bytes; over budget falls back to per-level launches (full-res pyramids).
-_MULTI_VMEM_BUDGET = 10 * 1024 * 1024
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -327,7 +345,9 @@ def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
                    # live across all levels
                    + ROW_BLK * W1_BLK * w2_max * fp32
                    + ROW_BLK * W1_BLK * len(fmap2_pyramid) * k * fp32)
-    if working_set <= _MULTI_VMEM_BUDGET:
+    # over the package-shared budget -> per-level launches (which
+    # shrink their row blocks for full-res pyramids)
+    if working_set <= VMEM_BUDGET:
         static = (radius,
                   tuple(int(sum(f.shape[2] for f in fmap2_pyramid[:i]))
                         for i in range(len(fmap2_pyramid))),

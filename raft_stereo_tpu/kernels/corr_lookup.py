@@ -36,6 +36,33 @@ from jax.experimental.pallas import tpu as pltpu
 ROW_BLK = 8       # (batch·H) rows per tile
 W1_BLK = 128      # output pixels per tile (lane-aligned)
 
+# Single per-program VMEM budget shared by ALL correlation kernels in this
+# package (this module and kernels/corr_alt.py).  Mosaic FAILS TO COMPILE
+# (no fallback) when a program's live set exceeds VMEM, so every launch
+# either gates on a working-set estimate or shrinks its row block with
+# ``row_blk_for`` until it fits.
+VMEM_BUDGET = 8 * 2 ** 20
+
+
+def row_blk_for(per_row_bytes: int) -> int:
+    """Largest power-of-two row block (≤ ROW_BLK) whose per-program working
+    set fits ``VMEM_BUDGET``; callers pass bytes-per-row-of-ROW_BLK=1."""
+    rb = ROW_BLK
+    while rb > 1 and rb * per_row_bytes > VMEM_BUDGET:
+        rb //= 2
+    return rb
+
+
+def _lookup_row_bytes(w2: int, radius: int, itemsize: int) -> int:
+    """Per-row working set of the single-level lookup kernels: volume tile
+    (input + fp32 upcast), hat field, product/scatter intermediate, out."""
+    fp32 = 4
+    k = 2 * radius + 1
+    return W1_BLK * (w2 * (itemsize + fp32)
+                     + (w2 + 2 * radius) * fp32
+                     + w2 * fp32
+                     + k * fp32)
+
 _interpret_override: Optional[bool] = None
 
 
@@ -96,63 +123,68 @@ def hat_scatter(g, centers, w2: int, radius: int):
 
 # ------------------------------------------------------------------ kernels
 def _fwd_kernel(vol_ref, coords_ref, out_ref, *, radius: int, scale: float):
-    """One (ROW_BLK, W1_BLK) tile: volume (R, W1B, W2) + centers (R, W1B)
-    → window samples (R, W1B, K)."""
+    """One (row-block, W1_BLK) tile: volume (R, W1B, W2) + centers
+    (R, W1B, 1) → window samples (R, W1B, K)."""
     vol = vol_ref[:].astype(jnp.float32)              # (R, W1B, W2)
-    centers = coords_ref[:].astype(jnp.float32) * scale   # (R, W1B)
+    centers = coords_ref[:, :, 0].astype(jnp.float32) * scale   # (R, W1B)
     for k, sample in hat_sample(vol, centers, radius):
         out_ref[:, :, k] = sample.astype(out_ref.dtype)
 
 
 def _bwd_kernel(coords_ref, g_ref, dvol_ref, *, radius: int, scale: float):
     """Tile transpose of the forward: g (R, W1B, K) → dV (R, W1B, W2)."""
-    centers = coords_ref[:].astype(jnp.float32) * scale
+    centers = coords_ref[:, :, 0].astype(jnp.float32) * scale
     g = g_ref[:].astype(jnp.float32)
     dvol = hat_scatter(g, centers, dvol_ref.shape[-1], radius)
     dvol_ref[:] = dvol.astype(dvol_ref.dtype)
 
 
 # ------------------------------------------------------------------- launch
+# coords blocks carry a trailing singleton so the (8, 128)-divisibility rule
+# on the last two block dims keeps holding when the row block shrinks below
+# 8 for VMEM (large W2).
 def _launch_fwd(vol: jnp.ndarray, coords: jnp.ndarray, radius: int,
                 scale: float) -> jnp.ndarray:
     rows, w1, w2 = vol.shape
     k = 2 * radius + 1
-    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    rb = row_blk_for(_lookup_row_bytes(w2, radius, vol.dtype.itemsize))
+    grid = (pl.cdiv(rows, rb), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, radius=radius, scale=scale),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK, w2), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, w2), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+            pl.BlockSpec((rb, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, w1, k), vol.dtype),
         interpret=_interpret(),
-    )(vol, coords)
+    )(vol, coords[..., None])
 
 
 def _launch_bwd(coords: jnp.ndarray, g: jnp.ndarray, w2: int, radius: int,
                 scale: float, dtype) -> jnp.ndarray:
     rows, w1 = coords.shape
     k = 2 * radius + 1
-    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    rb = row_blk_for(_lookup_row_bytes(w2, radius, jnp.dtype(dtype).itemsize))
+    grid = (pl.cdiv(rows, rb), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_bwd_kernel, radius=radius, scale=scale),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+            pl.BlockSpec((rb, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, w2), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((rb, W1_BLK, w2), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, w1, w2), dtype),
         interpret=_interpret(),
-    )(coords, g)
+    )(coords[..., None], g)
 
 
 # ----------------------------------------------------------- level sampling
@@ -289,11 +321,6 @@ def _sample_pyramid_bwd(radius, residuals, g):
 _sample_pyramid.defvjp(_sample_pyramid_fwd, _sample_pyramid_bwd)
 
 
-# Conservative per-program VMEM budget for the single-launch path (the
-# sibling alt kernel gates on the same number — kernels/corr_alt.py).
-_MULTI_VMEM_BUDGET = 10 * 2 ** 20
-
-
 def _multi_working_set(w2s, radius: int, itemsize: int) -> int:
     """Bytes one program of ``_fwd_kernel_multi`` holds live: per level the
     input tile, its fp32 upcast, and the (w2+2r)-wide fp32 hat field; plus
@@ -317,7 +344,7 @@ def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
     previously-working eval into a Mosaic VMEM compile failure)."""
     w2s = [v.shape[-1] for v in pyramid]
     if (len(pyramid) > 1 and _multi_working_set(
-            w2s, radius, pyramid[0].dtype.itemsize) <= _MULTI_VMEM_BUDGET):
+            w2s, radius, pyramid[0].dtype.itemsize) <= VMEM_BUDGET):
         return _sample_pyramid(tuple(pyramid), coords, radius)
     outs = [_sample_level(vol, coords, radius, 1.0 / (2 ** i))
             for i, vol in enumerate(pyramid)]

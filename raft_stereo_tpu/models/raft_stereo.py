@@ -30,6 +30,12 @@ from raft_stereo_tpu.models.update import BasicMultiUpdateBlock
 from raft_stereo_tpu.ops.grids import coords_grid_x
 from raft_stereo_tpu.ops.upsample import convex_upsample
 
+# Above this pixel count, fnet processes the two images sequentially instead
+# of as one batch-2 concat, halving the full-resolution stem's peak HBM
+# (KITTI/SceneFlow shapes stay on the batched path; Middlebury-F-class
+# frames take the sequential one).
+_SEQUENTIAL_FNET_PIXELS = 1_500_000
+
 
 class RAFTStereo(nn.Module):
     config: RaftStereoConfig
@@ -87,6 +93,24 @@ class RAFTStereo(nn.Module):
             levels, v = self.cnet(jnp.concatenate([image1, image2], axis=0))
             fmap = self.conv2_out(self.conv2_res(v))
             fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
+        elif (image1.shape[1] * image1.shape[2] >= _SEQUENTIAL_FNET_PIXELS):
+            # Full-resolution inputs: the stem runs at FULL image resolution
+            # when n_downsample <= 2 (matching the reference's stride gate,
+            # core/extractor.py:140), so its activations dominate peak HBM.
+            # Scanning fnet over the two images SEQUENTIALLY (weights shared,
+            # lax.scan => strictly ordered) halves that peak vs the batch-2
+            # concat — the difference between fitting Middlebury-F-class
+            # frames on a 16 GB chip or not (docs/TRAIN_PROFILE.md round 2).
+            levels, _ = self.cnet(image1)
+
+            def fnet_one(module, carry, img):
+                return carry, module.fnet(img)
+
+            fnet_scan = nn.scan(fnet_one,
+                                variable_broadcast=("params", "batch_stats"),
+                                split_rngs={"params": False})
+            _, fmaps = fnet_scan(self, None, jnp.stack([image1, image2]))
+            fmap1, fmap2 = fmaps[0], fmaps[1]
         else:
             levels, _ = self.cnet(image1)
             both = self.fnet(jnp.concatenate([image1, image2], axis=0))

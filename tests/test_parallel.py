@@ -186,3 +186,24 @@ def test_sharded_kernel_gradients_match_reg(rng, _interpret_mode):
     for a, b_ in zip(g_sh, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sharded_fullres_structure(rng, _interpret_mode):
+    """Full-resolution W2 STRUCTURE (Middlebury-F at 1/4 res has W2=496)
+    through the sharded volume + Pallas kernel on the virtual mesh — H kept
+    tiny so the CPU interpreter stays fast; the W2 math (padding quantum,
+    level widths 496/248/124/62, shard offsets) is the full-res case."""
+    cfg = RaftStereoConfig(corr_w2_shards=4, corr_backend="reg_fused")
+    mesh = make_mesh(n_data=2, n_corr=4)
+    b, h, w1, w2 = 1, 2, 496, 496
+    f1, f2 = _fmaps(rng, b, h, w1, w2, d=16)
+    coords = _coords(rng, b, h, w1, w2)
+    ref = make_corr_fn_reg(RaftStereoConfig(corr_backend="reg"),
+                           f1, f2)(coords)
+    with corr_sharding(mesh):
+        out = jax.jit(
+            lambda c: make_corr_fn_w2_sharded(cfg, f1, f2, mesh)(c)
+        )(coords)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
