@@ -58,6 +58,19 @@ def alt_fused_available() -> bool:
     return fused_lookup_available()
 
 
+def alt_fused_fits(w2: int, d: int, itemsize: int, radius: int) -> bool:
+    """False when even a ONE-row block of the (larger) backward launch
+    exceeds the VMEM budget — row_blk_for cannot shrink below 1, so callers
+    must fall back to the XLA path (make_corr_fn_alt) instead of hitting a
+    Mosaic compile failure (e.g. W2 beyond ~4k at d=256 fp32)."""
+    fp32 = 4
+    bwd_row = (_fwd_row_bytes(W1_BLK, w2, d, itemsize, radius)
+               + W1_BLK * d * fp32      # df1 tile
+               + w2 * d * fp32          # df2 accumulator tile
+               + W1_BLK * w2 * fp32)    # dv tile
+    return bwd_row <= VMEM_BUDGET
+
+
 # ------------------------------------------------------------------ kernels
 def _fwd_kernel(f1_ref, f2_ref, coords_ref, out_ref, *, radius: int,
                 scale: float, inv_sqrt_d: float, precision):
@@ -148,23 +161,23 @@ def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
     rows, w1, d = f1.shape
     w2 = f2.shape[1]
     k = 2 * radius + 1
-    ROW_BLK = row_blk_for(_fwd_row_bytes(W1_BLK, w2, d, f1.dtype.itemsize,
-                                      radius))
-    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    rb = row_blk_for(_fwd_row_bytes(W1_BLK, w2, d, f1.dtype.itemsize,
+                                    radius))
+    grid = (pl.cdiv(rows, rb), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, radius=radius, scale=scale,
                           inv_sqrt_d=inv_sqrt_d,
                           precision=_precision_for(f1.dtype)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((rb, w2, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, w1, k), f1.dtype),
         interpret=_interpret(),
@@ -176,31 +189,31 @@ def _launch_bwd(f1, f2, coords, g, radius, scale, inv_sqrt_d):
     w2 = f2.shape[1]
     k = 2 * radius + 1
     fp32 = 4
-    ROW_BLK = row_blk_for(
+    rb = row_blk_for(
         _fwd_row_bytes(W1_BLK, w2, d, f1.dtype.itemsize, radius)
         + W1_BLK * d * fp32    # df1 tile
         + w2 * d * fp32        # df2 accumulator tile
         + W1_BLK * w2 * fp32)  # dv tile
-    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    grid = (pl.cdiv(rows, rb), pl.cdiv(w1, W1_BLK))
     return pl.pallas_call(
         functools.partial(_bwd_kernel, radius=radius, scale=scale,
                           inv_sqrt_d=inv_sqrt_d, rows_total=rows,
                           w1_total=w1, precision=_precision_for(f1.dtype)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((rb, w2, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((rb, W1_BLK, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLK, w2, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((rb, w2, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[

@@ -114,8 +114,11 @@ def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
     # lookup; fp32 features get exact HIGHEST-precision MXU passes).  The
     # XLA path below is the correctness reference and off-TPU fallback.
     from raft_stereo_tpu.kernels.corr_alt import (alt_fused_available,
+                                                  alt_fused_fits,
                                                   alt_lookup_fused)
-    use_fused = alt_fused_available()
+    use_fused = (alt_fused_available()
+                 and alt_fused_fits(fmap2.shape[2], fmap1.shape[-1],
+                                    fmap1.dtype.itemsize, cfg.corr_radius))
     if not use_fused:
         # XLA fallback runs in fp32 like the reference's alt backend
         # (core/raft_stereo.py:95 forces fp32 for it).

@@ -1,0 +1,41 @@
+"""Force hermetic CPU-only jax in THIS process.
+
+Shared by tests/conftest.py and subprocess workers (distributed_worker.py):
+this environment's sitecustomize registers a remote-TPU PJRT plugin ("axon")
+at interpreter startup, the machine holds exactly ONE claim on the remote
+chip, and a test process that touches it would serialize against (and wedge
+behind) any other user of the chip.  One copy of the workaround so the two
+call sites cannot drift.
+"""
+
+import os
+import re
+
+
+def force_cpu(n_devices: int = 8):
+    """CPU backend with ``n_devices`` virtual devices; returns jax.
+
+    Replaces (not merely appends) any inherited device-count flag — a
+    subprocess worker spawned from the 8-device test process must get ITS
+    requested count."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+    try:  # deregister the remote-TPU plugin if sitecustomize installed it
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - plugin absent elsewhere
+        pass
+
+    import jax
+
+    # jax.config latched JAX_PLATFORMS at import time (sitecustomize imports
+    # jax before we run) — update it explicitly.
+    jax.config.update("jax_platforms", "cpu")
+    return jax

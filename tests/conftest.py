@@ -1,42 +1,25 @@
 """Test configuration: run all tests on CPU with 8 virtual devices.
 
 Multi-device sharding tests follow SURVEY.md §4's strategy: CPU-backed JAX
-standing in for TPU via ``--xla_force_host_platform_device_count``.
-
-Note: this environment's sitecustomize registers a remote-TPU PJRT plugin
-("axon") at interpreter startup — before conftest runs — and that plugin is
-initialized even under ``JAX_PLATFORMS=cpu``.  The machine has exactly one
-remote TPU claim, so a test suite touching it would serialize against (and
-wedge behind) any other process using the chip.  We deregister the plugin
-here so tests are hermetic and CPU-only.
+standing in for TPU via ``--xla_force_host_platform_device_count``.  The
+hermetic-CPU setup itself (including deregistering this environment's
+remote-TPU "axon" plugin) lives in tests/_hermetic.py, shared with the
+distributed-test subprocess workers.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(__file__))
+from _hermetic import force_cpu  # noqa: E402
 
-try:  # deregister the remote-TPU plugin if sitecustomize installed it
-    from jax._src import xla_bridge
-
-    xla_bridge._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - plugin absent in other environments
-    pass
-
-import jax
-
-# jax.config latched JAX_PLATFORMS at import time (sitecustomize imports jax
-# before conftest) — update it explicitly.
-jax.config.update("jax_platforms", "cpu")
+jax = force_cpu(8)
 
 assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
 assert len(jax.devices()) >= 8, "tests expect >= 8 virtual CPU devices"
 
-import numpy as np
-import pytest
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
 
 
 @pytest.fixture

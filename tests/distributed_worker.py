@@ -1,0 +1,77 @@
+"""Worker for the real 2-process distributed test (test_distributed.py).
+
+Each process: ``jax.distributed.initialize`` over a localhost coordinator,
+2 local virtual CPU devices (4 global), a (4, 1) mesh spanning both
+processes, and two SPMD train steps where each process contributes only its
+LOCAL slice of the global batch (``shard_batch`` →
+``jax.make_array_from_process_local_data`` — the branch single-process runs
+can never reach).  Writes the final params and losses for the parent test
+to compare across processes and against a single-process run.
+
+Usage: python distributed_worker.py <pid> <nproc> <coord_addr> <out.npz>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    coord, out_path = sys.argv[3], sys.argv[4]
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _hermetic import force_cpu
+
+    jax = force_cpu(2)
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+    from raft_stereo_tpu.parallel import distributed
+    from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from raft_stereo_tpu.training.state import create_train_state
+    from raft_stereo_tpu.training.step import make_train_step
+
+    mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), corr_levels=2,
+                            fnet_dim=32)
+    tcfg = TrainConfig(batch_size=8, train_iters=2, num_steps=10,
+                      image_size=(32, 48))
+    state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                               image_shape=(1, 32, 48, 3))
+    mesh = make_mesh(n_data=4)
+    state = replicate(state, mesh)
+    step_fn = make_train_step(tcfg, mesh=mesh, donate=False)
+
+    # the stop-flag collective the train loop runs each step
+    assert distributed.any_process(False) is False
+    assert distributed.any_process(pid == 0) is True
+
+    local = 8 // nproc
+    losses = []
+    for step in range(2):
+        rng = np.random.default_rng(100 + step)  # same GLOBAL batch everywhere
+        g = {
+            "image1": rng.uniform(0, 255, (8, 32, 48, 3)).astype(np.float32),
+            "image2": rng.uniform(0, 255, (8, 32, 48, 3)).astype(np.float32),
+            "flow": rng.normal(0, 5, (8, 32, 48)).astype(np.float32),
+            "valid": np.ones((8, 32, 48), np.float32),
+        }
+        local_batch = {k: v[pid * local:(pid + 1) * local] for k, v in g.items()}
+        state, metrics = step_fn(state, shard_batch(local_batch, mesh))
+        losses.append(float(metrics["loss"]))
+
+    # fully-replicated state: every process can read it
+    flat = np.concatenate([np.ravel(np.asarray(jax.device_get(x)))
+                           for x in jax.tree_util.tree_leaves(state.params)])
+    np.savez(out_path, params=flat, losses=np.asarray(losses))
+    print(f"worker {pid}: done, losses {losses}")
+
+
+if __name__ == "__main__":
+    main()

@@ -81,8 +81,11 @@ def test_alt_per_level_fallback_matches_multi(rng, _interpret_mode,
     coords = jnp.asarray(rng.uniform(-3, w2 + 3, (b, h, w1)), jnp.float32)
 
     multi = make_corr_fn_alt(cfg, f1, f2)(coords)
-    monkeypatch.setattr(corr_alt, "VMEM_BUDGET", 0)
-    monkeypatch.setattr(corr_lookup, "VMEM_BUDGET", 0)
+    # A budget big enough for 1-row blocks (alt_fused_fits stays True, the
+    # kernel stays engaged) but far below the multi launch's working set ->
+    # forces the per-level launch path specifically.
+    monkeypatch.setattr(corr_alt, "VMEM_BUDGET", 200_000)
+    monkeypatch.setattr(corr_lookup, "VMEM_BUDGET", 200_000)
     per_level = make_corr_fn_alt(cfg, f1, f2)(coords)
     np.testing.assert_array_equal(np.asarray(multi), np.asarray(per_level))
 
