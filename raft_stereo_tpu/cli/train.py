@@ -46,7 +46,9 @@ def configs_from_args(args):
         do_flip=args.do_flip,
         spatial_scale=tuple(args.spatial_scale),
         noyjitter=args.noyjitter,
+        validation_frequency=args.validation_frequency,
         seed=args.seed,
+        data_parallel=args.data_parallel,
     )
     return model_cfg, train_cfg
 
@@ -83,6 +85,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spatial_scale", type=float, nargs=2,
                    default=[-0.2, 0.4])
     p.add_argument("--noyjitter", action="store_true")
+    # periodic validation (reference: validate_things every 10k steps,
+    # train_stereo.py:183-193) — flag-gated because it needs datasets on disk
+    p.add_argument("--validate_datasets", nargs="+", default=None,
+                   choices=["things", "kitti", "eth3d", "middlebury"],
+                   help="run these validators every --validation_frequency "
+                        "steps (needs the datasets under --data_root)")
+    def _positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"{v}: must be >= 1")
+        return n
+    p.add_argument("--validation_frequency", type=_positive_int,
+                   default=10_000)
+    p.add_argument("--validate_max_images", type=int, default=None)
+    p.add_argument("--data_parallel", type=int, default=0,
+                   help="devices along the data axis (0 = all)")
     common.add_arch_overrides(p)
     return p
 
@@ -99,11 +117,20 @@ def main(argv=None):
     log.info("model config: %s", model_cfg.to_dict())
     log.info("train config: %s", train_cfg.to_dict())
 
+    validate_fn = None
+    if args.validate_datasets:
+        from raft_stereo_tpu.eval.validate import make_validation_fn
+        validate_fn = make_validation_fn(
+            model_cfg, train_cfg, data_root=args.data_root,
+            datasets=tuple(args.validate_datasets),
+            max_images=args.validate_max_images)
+
     from raft_stereo_tpu.training.train_loop import train
     return train(model_cfg, train_cfg, name=args.name,
                  data_root=args.data_root,
                  checkpoint_dir=args.checkpoint_dir,
-                 restore=args.restore_ckpt, log_dir=args.log_dir)
+                 restore=args.restore_ckpt, log_dir=args.log_dir,
+                 validate_fn=validate_fn)
 
 
 if __name__ == "__main__":

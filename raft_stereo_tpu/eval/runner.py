@@ -28,16 +28,36 @@ class InferenceRunner:
     """
 
     def __init__(self, config: RaftStereoConfig, variables,
-                 iters: int = 32, divis_by: int = 32):
+                 iters: int = 32, divis_by: int = 32,
+                 shape_bucket: Optional[int] = None,
+                 max_cached_shapes: int = 16):
+        """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
+        reference's /32, collapsing nearby image shapes into one compiled
+        program — fewer Middlebury recompiles at the cost of deviating from
+        the reference's exact padding (off by default; the parity tests
+        require /32 semantics).  ``max_cached_shapes`` bounds the per-shape
+        executable cache LRU-style so a many-shape eval (Middlebury-F) holds
+        memory flat instead of accumulating compiled programs forever."""
+        if shape_bucket is not None and shape_bucket % divis_by:
+            raise ValueError(f"shape_bucket={shape_bucket} must be a "
+                             f"multiple of the model's /{divis_by} "
+                             f"divisibility requirement")
+        if max_cached_shapes < 1:
+            raise ValueError(
+                f"max_cached_shapes={max_cached_shapes} must be >= 1")
         self.config = config
         self.variables = variables
         self.iters = iters
-        self.divis_by = divis_by
+        self.divis_by = shape_bucket or divis_by
+        self.max_cached_shapes = max_cached_shapes
         self.model = RAFTStereo(config)
         self._compiled: Dict[Tuple[int, int], any] = {}
 
     def _forward_for(self, padded_hw: Tuple[int, int]):
         if padded_hw not in self._compiled:
+            while len(self._compiled) >= self.max_cached_shapes:
+                # dicts iterate in insertion order -> drop the oldest
+                self._compiled.pop(next(iter(self._compiled)))
             model, iters = self.model, self.iters
 
             @jax.jit
@@ -46,6 +66,8 @@ class InferenceRunner:
                                    test_mode=True)
 
             self._compiled[padded_hw] = fwd
+        else:  # LRU refresh
+            self._compiled[padded_hw] = self._compiled.pop(padded_hw)
         return self._compiled[padded_hw]
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,

@@ -19,6 +19,7 @@ the warmup absorbs XLA compilation instead of cuDNN autotuning.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -69,6 +70,50 @@ def _validate(runner: InferenceRunner, dataset, name: str,
     else:
         print(f"Validation {name}: EPE {epe}, D1 {d1}")
     return result
+
+
+def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
+                       datasets: tuple = ("things",),
+                       max_images: Optional[int] = None):
+    """Periodic-validation hook for ``training.train_loop.train``.
+
+    Returns ``validate_fn(variables) -> dict`` running the named validators
+    every ``train_cfg.validation_frequency`` steps — the reference's
+    every-10k ``validate_things`` regression check
+    (reference: train_stereo.py:183-193), generalized to any subset of the
+    four benchmarks.  One InferenceRunner is reused across calls (variables
+    are a call argument of its jitted forward, so swapping them does not
+    recompile)."""
+    dispatch = {
+        "things": lambda r: validate_things(r, root=data_root,
+                                            max_images=max_images),
+        "kitti": lambda r: validate_kitti(
+            r, root=os.path.join(data_root, "KITTI"), max_images=max_images),
+        "eth3d": lambda r: validate_eth3d(
+            r, root=os.path.join(data_root, "ETH3D"), max_images=max_images),
+        "middlebury": lambda r: validate_middlebury(
+            r, root=os.path.join(data_root, "Middlebury"), split="H",
+            max_images=max_images),
+    }
+    unknown = set(datasets) - set(dispatch)
+    if unknown:
+        raise ValueError(f"unknown validation datasets {sorted(unknown)}; "
+                         f"choose from {sorted(dispatch)}")
+    runner = None
+
+    def validate_fn(variables):
+        nonlocal runner
+        if runner is None:
+            runner = InferenceRunner(model_cfg, variables,
+                                     iters=train_cfg.valid_iters)
+        else:
+            runner.variables = variables
+        results = {}
+        for name in datasets:
+            results.update(dispatch[name](runner))
+        return results
+
+    return validate_fn
 
 
 def validate_eth3d(runner: InferenceRunner, root: str = "datasets/ETH3D",

@@ -110,3 +110,50 @@ def test_train_loop_and_exact_resume(tmp_path):
                    checkpoint_dir=ckpt_dir, log_dir=str(tmp_path / "runs2"),
                    restore=os.path.join(ckpt_dir, "t"), loader=loader2)
     assert int(state2.step) == 5
+
+
+def test_train_cli_with_periodic_validation(tmp_path, capsys):
+    """The reference's every-N-steps validation regression check
+    (train_stereo.py:183-193), wired through the CLI: a 2-step run on a
+    synthetic KITTI tree validates at step 2 and logs the metrics dict."""
+    from raft_stereo_tpu.cli import train as train_cli
+
+    _make_kitti_tree(tmp_path / "KITTI", n=4, size=(64, 96))
+    state = train_cli.main([
+        "--name", "t", "--data_root", str(tmp_path),
+        "--checkpoint_dir", str(tmp_path / "ck"),
+        "--log_dir", str(tmp_path / "runs"),
+        "--train_datasets", "kitti", "--batch_size", "2", "--num_steps", "2",
+        "--train_iters", "2", "--valid_iters", "2",
+        "--image_size", "48", "64", "--hidden_dims", "32", "32", "32",
+        "--validate_datasets", "kitti", "--validation_frequency", "2",
+        "--validate_max_images", "2", "--data_parallel", "2",
+    ])
+    assert int(state.step) == 2
+    out = capsys.readouterr().out
+    assert "Validation kitti" in out
+
+
+def test_runner_cache_bounded_and_bucketed(tiny_checkpoint):
+    """Per-shape compile cache evicts LRU-style, and shape_bucket collapses
+    nearby shapes into one compiled program."""
+    import numpy as np
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.training.checkpoint import load_weights
+
+    cfg, variables = load_weights(tiny_checkpoint)
+    runner = InferenceRunner(cfg, variables, iters=1, max_cached_shapes=2)
+    rng = np.random.default_rng(0)
+    for h, w in ((32, 64), (64, 64), (64, 96), (32, 64)):
+        img = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+        flow, _ = runner(img, img)
+        assert flow.shape == (h, w)
+    assert len(runner._compiled) == 2  # bounded; oldest evicted
+
+    bucketed = InferenceRunner(cfg, variables, iters=1, shape_bucket=64)
+    for h, w in ((60, 90), (62, 94), (33, 65)):
+        img = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+        flow, _ = bucketed(img, img)
+        assert flow.shape == (h, w)  # exact unpad regardless of bucket
+    assert len(bucketed._compiled) == 1  # all bucket to (64, 128)
