@@ -98,8 +98,14 @@ def build_parser() -> argparse.ArgumentParser:
         return n
     p.add_argument("--validation_frequency", type=_positive_int,
                    default=10_000)
-    p.add_argument("--validate_max_images", type=int, default=None)
-    p.add_argument("--data_parallel", type=int, default=0,
+    p.add_argument("--validate_max_images", type=_positive_int,
+                   default=None)
+    def _nonneg_int(v):
+        n = int(v)
+        if n < 0:
+            raise argparse.ArgumentTypeError(f"{v}: must be >= 0")
+        return n
+    p.add_argument("--data_parallel", type=_nonneg_int, default=0,
                    help="devices along the data axis (0 = all)")
     common.add_arch_overrides(p)
     return p

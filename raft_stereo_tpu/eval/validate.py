@@ -77,7 +77,7 @@ def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
                        max_images: Optional[int] = None):
     """Periodic-validation hook for ``training.train_loop.train``.
 
-    Returns ``validate_fn(variables) -> dict`` running the named validators
+    Returns ``validate_fn(variables, model_cfg=None) -> dict`` running the named validators
     every ``train_cfg.validation_frequency`` steps — the reference's
     every-10k ``validate_things`` regression check
     (reference: train_stereo.py:183-193), generalized to any subset of the
@@ -101,9 +101,12 @@ def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
                          f"choose from {sorted(dispatch)}")
     runner = None
 
-    def validate_fn(variables):
+    def validate_fn(variables, model_cfg=model_cfg):
+        # model_cfg may be overridden per call: a --restore_ckpt re-derives
+        # the architecture inside train(), so the config captured here at
+        # CLI time can be stale (train_loop passes the authoritative one).
         nonlocal runner
-        if runner is None:
+        if runner is None or runner.config != model_cfg:
             runner = InferenceRunner(model_cfg, variables,
                                      iters=train_cfg.valid_iters)
         else:

@@ -53,8 +53,10 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     ``restore`` accepts a previous run's checkpoint directory (exact resume,
     optimizer state and step included) or a reference ``.pth`` (warm start,
     like the reference's --restore_ckpt).
-    ``validate_fn(variables) -> dict`` runs every
-    ``train_cfg.validation_frequency`` steps.
+    ``validate_fn(variables, model_cfg) -> dict`` runs every
+    ``train_cfg.validation_frequency`` steps; ``model_cfg`` is the
+    AUTHORITATIVE architecture (a checkpoint restore re-derives it, so a
+    config captured at CLI time could be stale).
     ``loader`` overrides dataset construction (used by tests).
     """
     # Defensive: form the process group (no-op single-host / already done)
@@ -186,7 +188,7 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                     variables = {"params": jax.device_get(state.params),
                                  "batch_stats":
                                      jax.device_get(state.batch_stats) or {}}
-                    logger.write_dict(validate_fn(variables))
+                    logger.write_dict(validate_fn(variables, model_cfg))
         # Final (or preemption) checkpoint — written while the stop-request
         # handler may still be installed, so a first signal here cannot kill
         # a half-written save.
