@@ -101,13 +101,16 @@ def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
                          f"choose from {sorted(dispatch)}")
     runner = None
 
-    def validate_fn(variables, model_cfg=model_cfg):
-        # model_cfg may be overridden per call: a --restore_ckpt re-derives
-        # the architecture inside train(), so the config captured here at
-        # CLI time can be stale (train_loop passes the authoritative one).
+    captured_cfg = model_cfg
+
+    def validate_fn(variables, model_cfg=None):
+        # model_cfg=None -> the config captured at construction; train()
+        # passes the authoritative one (a --restore_ckpt re-derives the
+        # architecture, so the CLI-time config can be stale).
+        cfg = captured_cfg if model_cfg is None else model_cfg
         nonlocal runner
-        if runner is None or runner.config != model_cfg:
-            runner = InferenceRunner(model_cfg, variables,
+        if runner is None or runner.config != cfg:
+            runner = InferenceRunner(cfg, variables,
                                      iters=train_cfg.valid_iters)
         else:
             runner.variables = variables

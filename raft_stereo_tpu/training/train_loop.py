@@ -127,6 +127,21 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         loader = StereoLoader(mixture, batch_size=train_cfg.batch_size,
                               seed=train_cfg.seed,
                               **distributed.loader_shard_kwargs())
+    # Adapt the validation hook's arity ONCE, before the loop: a legacy
+    # one-arg validate_fn(variables) must not TypeError hours in at the
+    # first validation boundary.
+    run_validation = None
+    if validate_fn is not None:
+        import inspect
+        try:
+            n_params = len(inspect.signature(validate_fn).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+        if n_params >= 2:
+            run_validation = lambda v: validate_fn(v, model_cfg)  # noqa: E731
+        else:
+            run_validation = validate_fn
+
     step_fn = make_train_step(train_cfg, mesh=mesh)
     _, schedule = make_optimizer(train_cfg)
     logger = Logger(log_dir=log_dir, total_steps=start_step)
@@ -184,11 +199,11 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                 save_path = os.path.join(checkpoint_dir,
                                          f"{step}_{name}")
                 _save(save_path, model_cfg, state, step)
-                if validate_fn is not None:
+                if run_validation is not None:
                     variables = {"params": jax.device_get(state.params),
                                  "batch_stats":
                                      jax.device_get(state.batch_stats) or {}}
-                    logger.write_dict(validate_fn(variables, model_cfg))
+                    logger.write_dict(run_validation(variables))
         # Final (or preemption) checkpoint — written while the stop-request
         # handler may still be installed, so a first signal here cannot kill
         # a half-written save.
