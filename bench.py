@@ -15,7 +15,11 @@ values to cancel dispatch/round-trip overhead:
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` divides by 26 FPS — the reference paper's realtime-model
-RTX-6000 claim (arXiv 2109.07547; external, see BASELINE.md).  North star
+RTX-6000 claim (arXiv 2109.07547; external, see BASELINE.md — the repo
+publishes no measured number, so the denominator inherits the paper's
+uncertainty).  Chip-side variance behind this environment's tunnel is
+±20%+ run to run (throttling / shared tenancy — BENCH_TRAIN_r02.json's
+roofline probes quantify it); compare trends, not single runs.  North star
 (BASELINE.json): vs_baseline >= 4.
 """
 

@@ -35,6 +35,10 @@ def add_arch_overrides(parser: argparse.ArgumentParser):
                         help="extra coarse-GRU updates per iteration")
     parser.add_argument("--mixed_precision", action="store_true",
                         help="bf16 compute")
+    parser.add_argument("--banded_encoder", action="store_true",
+                        help="stream full-resolution encoder stages in "
+                             "bands (several-fold lower peak HBM for huge "
+                             "frames; ~20%% slower)")
 
 
 def arch_overrides(args) -> Dict[str, Any]:
@@ -45,6 +49,8 @@ def arch_overrides(args) -> Dict[str, Any]:
         out["slow_fast_gru"] = True
     if args.mixed_precision:
         out["mixed_precision"] = True
+    if args.banded_encoder:
+        out["banded_encoder"] = True
     return out
 
 

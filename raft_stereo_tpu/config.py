@@ -76,6 +76,13 @@ class RaftStereoConfig:
     # ~10x less activation memory).  Turn off when per-device batch is small
     # enough (e.g. data-parallel over many chips) to trade memory for speed.
     remat_gru: bool = True
+    # Stream the encoders' FULL-RESOLUTION stages in horizontal bands
+    # (models/banded.py): only band-sized activations exist, cutting peak
+    # HBM several-fold at Middlebury-F-class resolutions in exchange for
+    # ~3.5x the (cheap) stem FLOPs when instance norm needs global-stats
+    # sweeps.  Opt-in; supported for n_downsample=2 with
+    # instance/batch/none norms (the published configurations).
+    banded_encoder: bool = False
     # Extension beyond the reference: shard the W2 (disparity-search) axis of
     # the correlation volume across a mesh axis for full-res inputs.
     corr_w2_shards: int = 1

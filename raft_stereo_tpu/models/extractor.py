@@ -94,9 +94,15 @@ class BasicEncoder(nn.Module):
     dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x):
-        x = _Trunk(self.norm_fn, self.downsample, self.dtype, name="trunk")(x)
-        return conv(self.output_dim, 1, 1, dtype=self.dtype, name="conv2")(x)
+    def __call__(self, x, trunk_out=None):
+        # ``trunk_out`` lets the banded executor (models/banded.py) supply
+        # the trunk output computed stream-wise on the SAME parameter tree;
+        # only ever passed at apply time, so init still creates all params.
+        if trunk_out is None:
+            trunk_out = _Trunk(self.norm_fn, self.downsample, self.dtype,
+                               name="trunk")(x)
+        return conv(self.output_dim, 1, 1, dtype=self.dtype,
+                    name="conv2")(trunk_out)
 
 
 class MultiBasicEncoder(nn.Module):
@@ -122,8 +128,12 @@ class MultiBasicEncoder(nn.Module):
     dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x):
-        x = _Trunk(self.norm_fn, self.downsample, self.dtype, name="trunk")(x)
+    def __call__(self, x, trunk_out=None):
+        # see BasicEncoder.__call__: banded-executor entry point
+        if trunk_out is None:
+            trunk_out = _Trunk(self.norm_fn, self.downsample, self.dtype,
+                               name="trunk")(x)
+        x = trunk_out
         v = x
         if self.dual_inp:
             x = x[: x.shape[0] // 2]

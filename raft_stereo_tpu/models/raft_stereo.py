@@ -89,11 +89,37 @@ class RAFTStereo(nn.Module):
         image1 = (2 * (image1 / 255.0) - 1.0).astype(dtype)
         image2 = (2 * (image2 / 255.0) - 1.0).astype(dtype)
 
+        use_banded = (cfg.banded_encoder and not self.is_initializing())
+        if use_banded:
+            from raft_stereo_tpu.models.banded import (banded_supported,
+                                                       banded_trunk_apply)
+            for norm in (cfg.context_norm,
+                         *((cfg.fnet_norm,) if not cfg.shared_backbone
+                           else ())):
+                if not banded_supported(norm, cfg.n_downsample):
+                    raise ValueError(
+                        f"banded_encoder: norm {norm!r} with "
+                        f"n_downsample={cfg.n_downsample} is unsupported")
+
+            def banded_trunk(module, x, norm_fn):
+                mvars = module.variables
+                return banded_trunk_apply(
+                    mvars["params"]["trunk"],
+                    mvars.get("batch_stats", {}).get("trunk", {}),
+                    x, norm_fn, dtype)
+
         if cfg.shared_backbone:
-            levels, v = self.cnet(jnp.concatenate([image1, image2], axis=0))
+            both = jnp.concatenate([image1, image2], axis=0)
+            if use_banded:
+                levels, v = self.cnet(
+                    both, trunk_out=banded_trunk(self.cnet, both,
+                                                 cfg.context_norm))
+            else:
+                levels, v = self.cnet(both)
             fmap = self.conv2_out(self.conv2_res(v))
             fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
-        elif (image1.shape[1] * image1.shape[2] >= _SEQUENTIAL_FNET_PIXELS):
+        elif (use_banded or image1.shape[1] * image1.shape[2]
+                >= _SEQUENTIAL_FNET_PIXELS):
             # Full-resolution inputs: the stem runs at FULL image resolution
             # when n_downsample <= 2 (matching the reference's stride gate,
             # core/extractor.py:140), so its activations dominate peak HBM.
@@ -101,10 +127,17 @@ class RAFTStereo(nn.Module):
             # lax.scan => strictly ordered) halves that peak vs the batch-2
             # concat — the difference between fitting Middlebury-F-class
             # frames on a 16 GB chip or not (docs/TRAIN_PROFILE.md round 2).
-            levels, _ = self.cnet(image1)
+            # With banded_encoder, each trunk additionally streams its
+            # full-resolution stages band by band (models/banded.py).
+            levels, _ = self.cnet(
+                image1, trunk_out=banded_trunk(self.cnet, image1,
+                                               cfg.context_norm)
+                if use_banded else None)
 
             def fnet_one(module, carry, img):
-                return carry, module.fnet(img)
+                trunk_out = (banded_trunk(module.fnet, img, cfg.fnet_norm)
+                             if use_banded else None)
+                return carry, module.fnet(img, trunk_out=trunk_out)
 
             fnet_scan = nn.scan(fnet_one,
                                 variable_broadcast=("params", "batch_stats"),
