@@ -23,6 +23,8 @@ from __future__ import annotations
 import functools
 import json
 
+import argparse
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +37,12 @@ REPEATS = 3
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--banded", action="store_true",
+                    help="banded encoder (models/banded.py): several-fold "
+                         "lower peak HBM, ~20%% slower at full res")
+    args = ap.parse_args()
+
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
     from raft_stereo_tpu.profiling import chained_seconds_per_call
@@ -46,7 +54,8 @@ def main():
     results = []
     variables = None
     for backend in BACKENDS:
-        cfg = RaftStereoConfig(corr_backend=backend)
+        cfg = RaftStereoConfig(corr_backend=backend,
+                               banded_encoder=args.banded)
         model = RAFTStereo(cfg)
         if variables is None:
             img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
@@ -66,7 +75,8 @@ def main():
                 return jax.lax.fori_loop(0, k, body, jnp.float32(0))
 
             rec = {"metric": "fullres_inference", "backend": backend,
-                   "size": f"{h}x{w}", "iters": ITERS}
+                   "size": f"{h}x{w}", "iters": ITERS,
+                   "banded_encoder": args.banded}
             try:
                 compiled = chain.lower(variables, img1, img2, 1).compile()
                 ma = compiled.memory_analysis()
