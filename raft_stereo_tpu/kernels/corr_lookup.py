@@ -226,7 +226,7 @@ _sample_level.defvjp(_sample_level_fwd, _sample_level_bwd)
 # levels stay separate pallas_call operands — no concatenated-volume copy.
 
 def _fwd_kernel_multi(*refs, radius: int, levels: int):
-    coords = refs[levels][:].astype(jnp.float32)
+    coords = refs[levels][:, :, 0].astype(jnp.float32)
     out_ref = refs[levels + 1]
     k = 2 * radius + 1
     for i in range(levels):
@@ -238,7 +238,7 @@ def _fwd_kernel_multi(*refs, radius: int, levels: int):
 
 def _bwd_kernel_multi(coords_ref, g_ref, *dvol_refs, radius: int,
                       levels: int):
-    coords = coords_ref[:].astype(jnp.float32)
+    coords = coords_ref[:, :, 0].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     k = 2 * radius + 1
     for i in range(levels):
@@ -259,7 +259,7 @@ def _launch_fwd_multi(vols, coords, radius: int):
         in_specs=[pl.BlockSpec((ROW_BLK, W1_BLK, v.shape[-1]),
                                lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM) for v in vols]
-                 + [pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                 + [pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
                                  memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, levels * k),
                                lambda i, j: (i, j, 0),
@@ -267,7 +267,7 @@ def _launch_fwd_multi(vols, coords, radius: int):
         out_shape=jax.ShapeDtypeStruct((rows, w1, levels * k),
                                        vols[0].dtype),
         interpret=_interpret(),
-    )(*vols, coords)
+    )(*vols, coords[..., None])
 
 
 def _launch_bwd_multi(coords, g, w2s, radius: int, dtype):
@@ -279,7 +279,7 @@ def _launch_bwd_multi(coords, g, w2s, radius: int, dtype):
         functools.partial(_bwd_kernel_multi, radius=radius, levels=levels),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+            pl.BlockSpec((ROW_BLK, W1_BLK, 1), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((ROW_BLK, W1_BLK, levels * k), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -289,7 +289,7 @@ def _launch_bwd_multi(coords, g, w2s, radius: int, dtype):
         out_shape=[jax.ShapeDtypeStruct((rows, w1, w2), dtype)
                    for w2 in w2s],
         interpret=_interpret(),
-    )(coords, g)
+    )(coords[..., None], g)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -324,13 +324,17 @@ _sample_pyramid.defvjp(_sample_pyramid_fwd, _sample_pyramid_bwd)
 def _multi_working_set(w2s, radius: int, itemsize: int) -> int:
     """Bytes one program of ``_fwd_kernel_multi`` holds live: per level the
     input tile, its fp32 upcast, and the (w2+2r)-wide fp32 hat field; plus
-    the all-levels output tile."""
+    the per-tap multiply-reduce product (one level live at a time — sized by
+    the widest level, matching the ``w2 * fp32`` term ``_lookup_row_bytes``
+    counts so the two estimators agree) and the all-levels output tile."""
     fp32 = 4
     k = 2 * radius + 1
     per_level = sum(
         ROW_BLK * W1_BLK * (w2 * (itemsize + fp32) + (w2 + 2 * radius) * fp32)
         for w2 in w2s)
-    return per_level + ROW_BLK * W1_BLK * len(w2s) * k * fp32
+    return (per_level
+            + ROW_BLK * W1_BLK * max(w2s) * fp32
+            + ROW_BLK * W1_BLK * len(w2s) * k * fp32)
 
 
 def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,

@@ -21,6 +21,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from raft_stereo_tpu.config import RaftStereoConfig
 from raft_stereo_tpu.models.corr import make_corr_fn
@@ -171,7 +172,11 @@ class RAFTStereo(nn.Module):
         def gru_step(module, net_list, disp):
             """One refinement iteration (reference: core/raft_stereo.py:108-123)."""
             disp = jax.lax.stop_gradient(disp)
-            corr = corr_fn(grid_x + disp).astype(dtype)
+            # Named so the remat policy below can SAVE this lookup's output:
+            # the backward then reuses it instead of re-running the Pallas
+            # kernel (a measured ~10% of step time; docs/TRAIN_PROFILE.md).
+            corr = checkpoint_name(
+                corr_fn(grid_x + disp).astype(dtype), "corr_lookup")
             flow2 = jnp.stack([disp, jnp.zeros_like(disp)],
                               axis=-1).astype(dtype)
 
@@ -223,8 +228,16 @@ class RAFTStereo(nn.Module):
         if cfg.remat_gru:
             # Backward recomputes each iteration from its carry instead of
             # storing every update-block activation (see config.remat_gru).
-            # prevent_cse=False is safe (and recommended) under scan.
-            body_train = nn.remat(body_train, prevent_cse=False)
+            # Exception: the correlation lookup output is SAVED (named above)
+            # — it is small (K·levels channels at 1/2^n resolution, ~2 MB/iter
+            # at the SceneFlow config) while its recompute is a full Pallas
+            # kernel launch per iteration, the single largest remat overhead
+            # in the training trace.  prevent_cse=False is safe (and
+            # recommended) under scan.
+            body_train = nn.remat(
+                body_train, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "corr_lookup"))
         scan_train = nn.scan(body_train, variable_broadcast=("params", "batch_stats"),
                              split_rngs={"params": False}, length=iters)
         (net_fin, disp_fin), flow_ups = scan_train(

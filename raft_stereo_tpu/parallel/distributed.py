@@ -94,8 +94,12 @@ def any_process(flag: bool) -> bool:
     """Global OR of a per-process bool.
 
     This is a COLLECTIVE in multi-process runs — every process must call it
-    the same number of times (the train loop calls it once per step).  It
-    coordinates the preemption stop: a SIGTERM landing on one host (or at
+    the same number of times.  The train loop calls it once per loop
+    iteration and folds its own loader's exhaustion into ``flag``, so the
+    invariant survives sharded loaders of UNEQUAL length: every process
+    keeps entering the collective until the global OR fires, then all break
+    together at the earliest exhaustion.  It coordinates the preemption
+    stop: a SIGTERM landing on one host (or at
     different step boundaries on different hosts) must make EVERY process
     break the loop at the same step, or the processes that kept going would
     dispatch step collectives while the stopping one enters the collective

@@ -31,7 +31,7 @@ from raft_stereo_tpu.parallel import distributed
 from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
 from raft_stereo_tpu.training import checkpoint as ckpt
-from raft_stereo_tpu.training.logger import Logger
+from raft_stereo_tpu.training.logger import Logger, SUM_FREQ
 from raft_stereo_tpu.training.optimizer import make_optimizer
 from raft_stereo_tpu.training.state import TrainState, create_train_state
 from raft_stereo_tpu.training.step import make_train_step
@@ -71,6 +71,11 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             f"corr_w2_shards={n_corr} exceeds the {len(devices)} available "
             f"devices — no device is left for the data axis")
     n_data = train_cfg.data_parallel or len(devices) // n_corr
+    if use_mesh and n_data * n_corr > len(devices):
+        raise ValueError(
+            f"data_parallel={n_data} x corr_w2_shards={n_corr} needs "
+            f"{n_data * n_corr} devices but only {len(devices)} are "
+            f"available")
     if train_cfg.batch_size % n_data:
         raise ValueError(f"batch_size={train_cfg.batch_size} not divisible "
                          f"by {n_data} data-parallel devices")
@@ -179,23 +184,54 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         for sig in (signal.SIGTERM, signal.SIGINT):
             prev_handlers[sig] = signal.signal(sig, _request_stop)
 
+    # Device-side metric dicts awaiting a host fetch.  Fetching per step
+    # would force a host sync every step, pinning the device to the Python
+    # loop's pace; buffering SUM_FREQ steps (the logger's own aggregation
+    # cadence) lets async dispatch run the device ahead and costs one
+    # transfer of ~8 scalars x SUM_FREQ instead of SUM_FREQ round-trips.
+    pending_metrics = []
+
+    def drain_metrics():
+        if not pending_metrics:
+            return
+        fetched = jax.device_get(pending_metrics)
+        pending_metrics.clear()
+        first = step - len(fetched) + 1
+        # One vectorized schedule eval for the whole span (the per-step
+        # float(schedule(step)) alternative is itself a device sync).
+        lrs = np.asarray(schedule(np.arange(first, step + 1)))
+        for m, lr in zip(fetched, lrs):
+            logger.push(m, lr=float(lr))
+
     try:
-        for batch in loader:
+        batches = iter(loader)
+        while True:
+            # Fetch BEFORE the stop collective so loader exhaustion is part
+            # of the global stop decision: any_process's call-count invariant
+            # (once per loop iteration on EVERY process) would break if one
+            # process's sharded loader ran a step short and left this loop
+            # early — the others would hang in the next allgather.  With
+            # exhaustion folded into the collective, all processes break
+            # together at the earliest exhaustion.
+            batch = next(batches, None)
             # The stop decision must be GLOBAL: a signal lands on one host
             # only, and every process has to break at the same step boundary
             # before the collective checkpoint save (any_process is itself a
-            # collective — called unconditionally once per step; `step` is
+            # collective — called once per loop iteration; `step` is
             # identical on all processes so the short-circuit is consistent).
-            if step >= total or distributed.any_process(stop_requested):
+            if step >= total or distributed.any_process(
+                    stop_requested or batch is None):
                 break
             if mesh is not None:
                 batch = shard_batch(batch, mesh)
             state, metrics = step_fn(state, batch)
             step += 1
-            logger.push(jax.device_get(metrics),
-                        lr=float(schedule(step)))
+            pending_metrics.append(metrics)
+            if len(pending_metrics) >= SUM_FREQ:
+                drain_metrics()
 
             if step % train_cfg.validation_frequency == 0 or step == total:
+                drain_metrics()
                 save_path = os.path.join(checkpoint_dir,
                                          f"{step}_{name}")
                 _save(save_path, model_cfg, state, step)
@@ -209,6 +245,14 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         # a half-written save.
         _save(os.path.join(checkpoint_dir, name), model_cfg, state, step)
     finally:
+        # Also on the exception path: a crash at step N must not discard the
+        # buffered metrics of steps N-1..N-SUM_FREQ+1 — that window of the
+        # loss curve is exactly what diagnoses the crash.  Guarded so a
+        # failed fetch can't mask the original exception.
+        try:
+            drain_metrics()
+        except Exception:
+            log.exception("could not drain buffered metrics")
         logger.close()
         _restore_handlers()
 
