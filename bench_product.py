@@ -1,0 +1,99 @@
+"""Product-path FPS: the REAL KITTI evaluation harness on the chip.
+
+bench.py times a bare on-device forward chain; the reference's protocol
+(reference: evaluate_stereo.py:60-109) runs a Python loop with a per-image
+host->device copy, /32 pad, forward, unpad, and device->host fetch.  This
+script runs OUR product harness — ``eval.validate.validate_kitti`` over a
+synthetic KITTI-layout tree at the real 375x1242 resolution (the honest
+per-image stop clock is the result fetch; see eval/runner.py) — next to the
+bare-forward chained measurement, so the flagship FPS number and the
+product path finally meet and their gap is a measurement.
+
+Prints one JSON line (bench.py contract): value = product-path FPS;
+``bare_forward_fps`` and ``gap`` fields explain the difference (per-image
+Python/dispatch/copy overhead on this host).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+N_IMAGES = 70          # warmup discards the first 50 (evaluate_stereo.py:105)
+KITTI_HW = (375, 1242)
+ITERS = 7              # realtime protocol depth (bench.py)
+K_LO, K_HI = 3, 23
+REPEATS = 3
+
+
+def main():
+    from golden_data import make_kitti
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.eval.validate import validate_kitti
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.profiling import chained_seconds_per_call
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    cfg = RaftStereoConfig.realtime()
+    model = RAFTStereo(cfg)
+    img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img_s, img_s, iters=1,
+                                             test_mode=True)
+                        )(jax.random.PRNGKey(0))
+
+    # --- product path: the real KITTI validator over a synthetic tree
+    with tempfile.TemporaryDirectory(prefix="kittibench_") as td:
+        root = os.path.join(td, "KITTI")
+        make_kitti(root, np.random.default_rng(0), n=N_IMAGES, hw=KITTI_HW)
+        runner = InferenceRunner(cfg, variables, iters=ITERS)
+        res = validate_kitti(runner, root=root)
+
+    # --- bare forward at the same padded shape (bench.py's method)
+    h = -(-KITTI_HW[0] // 32) * 32
+    w = -(-KITTI_HW[1] // 32) * 32
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def chain(variables, image1, image2, k):
+        def body(i, acc):
+            _, up = model.apply(variables, image1 + i * 1e-6, image2,
+                                iters=ITERS, test_mode=True)
+            return acc + jnp.mean(up)
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    bare_s = chained_seconds_per_call(
+        lambda k: (lambda: float(chain(variables, img1, img2, k))),
+        k_lo=K_LO, k_hi=K_HI, repeats=REPEATS)
+
+    fps_product = res["kitti-fps"]
+    fps_bare = 1.0 / bare_s
+    print(json.dumps({
+        "metric": "product_path_fps_kitti",
+        "value": round(fps_product, 2),
+        "unit": "frames/s (validate_kitti end-to-end, 375x1242)",
+        "bare_forward_fps": round(fps_bare, 2),
+        "gap": round(fps_product / fps_bare, 3),
+        "per_image_overhead_ms": round(1e3 * (1 / fps_product - bare_s), 2),
+        "kitti_epe_random_weights": round(res["kitti-epe"], 2),
+        "n_timed": N_IMAGES - 51,
+    }))
+
+
+if __name__ == "__main__":
+    main()

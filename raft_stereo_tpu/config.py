@@ -86,6 +86,17 @@ class RaftStereoConfig:
     # Extension beyond the reference: shard the W2 (disparity-search) axis of
     # the correlation volume across a mesh axis for full-res inputs.
     corr_w2_shards: int = 1
+    # Pixel count above which fnet processes the two images sequentially
+    # instead of as one batch-2 concat (halves the full-resolution stem's
+    # peak HBM).  None = derive from the local device's HBM at trace time
+    # (models/raft_stereo.sequential_fnet_threshold — measured stem
+    # bytes/pixel, tools/fullres_gates.py); 0 forces always-sequential, a
+    # huge value forces always-batched.
+    sequential_fnet_pixels: Optional[int] = None
+    # Row height of the banded encoder's streaming bands (banded_encoder
+    # only).  None = derive from device HBM and image width at trace time
+    # (models/banded.default_band_rows); must be even (stride-2 alignment).
+    band_rows: Optional[int] = None
 
     def __post_init__(self):
         if self.context_dims is None:
@@ -102,6 +113,11 @@ class RaftStereoConfig:
             raise ValueError(
                 "n_gru_layers must be in [1, min(len(hidden_dims), 3)] — the "
                 "update block implements at most 3 GRU levels")
+        if self.band_rows is not None and (self.band_rows < 2
+                                           or self.band_rows % 2):
+            raise ValueError(
+                f"band_rows={self.band_rows} must be an even integer >= 2 "
+                f"(stride-2 alignment of the banded encoder)")
         if self.corr_w2_shards > 1 and self.corr_backend == "alt":
             raise ValueError(
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "

@@ -27,9 +27,17 @@ except ImportError:  # pragma: no cover
 
 
 # ----------------------------------------------------------- photometric ops
-def _blend(a: np.ndarray, b: np.ndarray, factor: float) -> np.ndarray:
-    out = factor * a.astype(np.float32) + (1.0 - factor) * b
-    return np.clip(out, 0, 255).astype(np.uint8)
+def _blend(a: np.ndarray, b, factor: float) -> np.ndarray:
+    """``factor*a + (1-factor)*b`` clipped to uint8 — in-place fp32 ops (one
+    temporary instead of four; the loader's per-sample cost is dominated by
+    these full-frame blends, bench_loader.py)."""
+    out = a.astype(np.float32)
+    out *= np.float32(factor)
+    bb = (1.0 - factor) * b
+    if isinstance(bb, np.ndarray) or bb:  # brightness blends with 0: skip
+        out += bb
+    np.clip(out, 0, 255, out=out)
+    return out.astype(np.uint8)
 
 
 def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
@@ -37,7 +45,9 @@ def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
 
 
 def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
-    gray_mean = img.astype(np.float32).mean(axis=-1).mean()
+    # mean(dtype=f32) accumulates uint8 in fp32 without materializing the
+    # fp32 copy — same reduction order as .astype(f32).mean(-1).mean()
+    gray_mean = img.mean(axis=-1, dtype=np.float32).mean(dtype=np.float32)
     return _blend(img, gray_mean, factor)
 
 

@@ -38,7 +38,9 @@ class StereoDataset:
     """Base dataset: image pair + disparity GT → training sample dict.
 
     ``__getitem__(i, epoch)`` returns
-    ``{"image1", "image2"}: (H,W,3) float32 0..255,
+    ``{"image1", "image2"}: (H,W,3) uint8 0..255 (normalized on DEVICE,
+      models/raft_stereo.py:89-90 — uint8 quarters the host->device batch
+      transfer),
       "flow": (H,W) float32 x-flow (= -disparity),
       "valid": (H,W) float32 in {0,1}`` — cropped to ``crop_size`` when an
     augmentor is configured.
@@ -118,9 +120,14 @@ class StereoDataset:
             img1 = np.pad(img1, pad)
             img2 = np.pad(img2, pad)
 
+        # Images stay uint8: the decode/augment chain is uint8 end-to-end
+        # and the model normalizes on device (models/raft_stereo.py:89-90),
+        # so a float cast here would only 4x the host->device batch
+        # transfer (59 -> 26 MB/step at the SceneFlow config — measured to
+        # matter behind a remote device tunnel, bench_loader.py).
         return {
-            "image1": np.ascontiguousarray(img1, np.float32),
-            "image2": np.ascontiguousarray(img2, np.float32),
+            "image1": np.ascontiguousarray(img1),
+            "image2": np.ascontiguousarray(img2),
             "flow": np.ascontiguousarray(flow[..., 0], np.float32),
             "valid": valid,
         }

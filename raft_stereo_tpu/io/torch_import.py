@@ -183,6 +183,12 @@ def import_torch_checkpoint(path: str,
 
     flat = {}
     skipped = []
+    # The reference keeps separate convz/convr gate convs (core/update.py:
+    # 18-19); our ConvGRU runs them as one ``convzr`` conv over the shared
+    # [h, x] input (models/update.py).  Collect both halves per GRU here and
+    # concatenate along the output-channel axis below — z first, matching
+    # the split order in ConvGRU.
+    pending_zr: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
     for key, value in state.items():
         if key.endswith(_SKIP_SUFFIXES):
             continue
@@ -191,6 +197,11 @@ def import_torch_checkpoint(path: str,
             continue
         leaf = module_path[-1]
         module_path = module_path[:-1]
+        if module_path and module_path[-1] in ("convz", "convr"):
+            gate = module_path[-1][-1]  # 'z' | 'r'
+            slot = module_path[:-1] + ("convzr", leaf)
+            pending_zr.setdefault(slot, {})[gate] = value
+            continue
         if leaf == "weight":
             if value.ndim == 4:  # conv OIHW → HWIO
                 entry = ("params",) + module_path + ("kernel",)
@@ -215,6 +226,27 @@ def import_torch_checkpoint(path: str,
         if tuple(value.shape) != tuple(expect):
             raise ValueError(
                 f"{key}: shape {value.shape} != expected {expect} at "
+                f"{'/'.join(entry)}")
+        flat[entry] = jnp.asarray(value)
+
+    for (*path, leaf), halves in pending_zr.items():
+        if set(halves) != {"z", "r"}:
+            raise ValueError(
+                f"incomplete convz/convr pair at {'/'.join(path)}: "
+                f"got {sorted(halves)}")
+        value = np.concatenate([halves["z"], halves["r"]], axis=0)  # O axis
+        if leaf == "weight":
+            entry = ("params",) + tuple(path) + ("kernel",)
+            value = value.transpose(2, 3, 1, 0)  # OIHW → HWIO
+        else:
+            entry = ("params",) + tuple(path) + ("bias",)
+        if entry not in flat_template:
+            skipped.append("/".join(path) + f".{leaf}")  # unused gru level
+            continue
+        expect = flat_template[entry].shape
+        if tuple(value.shape) != tuple(expect):
+            raise ValueError(
+                f"fused convzr: shape {value.shape} != expected {expect} at "
                 f"{'/'.join(entry)}")
         flat[entry] = jnp.asarray(value)
 

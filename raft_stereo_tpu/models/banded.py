@@ -161,11 +161,40 @@ def _residual_block(tp, batch_stats, x, name, stride, norm_fn, dtype):
     return jnn.relu(x + y)
 
 
+# Peak-HBM bytes one band of the streaming segment holds live, per
+# (row x width-pixel x batch-sample): the haloed input band, the 64-channel
+# conv/norm intermediates of the deepest sweep, and their fp32 upcasts.
+# Measured on the TPU v5 lite chip via tools/fullres_gates.py (peak HBM of a
+# banded trunk forward minus baseline, divided by band rows x W).
+_BAND_BYTES_PER_ROW_PIXEL = 1536
+# Fraction of device HBM the resident band working set may occupy.  The
+# rest stays available for the off-band stages (1/2-res tail, correlation,
+# GRU state) that coexist with the streamed stem.
+_BAND_HBM_FRACTION = 1 / 16
+_BAND_MIN, _BAND_MAX = 64, 1024
+
+
+def default_band_rows(n: int, w: int) -> int:
+    """Band height derived from device HBM: the largest even band whose
+    working set (``n * w * band * _BAND_BYTES_PER_ROW_PIXEL``) stays under
+    ``_BAND_HBM_FRACTION`` of HBM, clamped to [64, 1024].  At W=2880 on a
+    16 GiB chip this reproduces the band=256 that carried the round-2
+    full-resolution measurements (FULLRES_r02.json)."""
+    from raft_stereo_tpu.profiling import device_hbm_bytes
+    budget = _BAND_HBM_FRACTION * device_hbm_bytes()
+    band = int(budget // (max(n, 1) * w * _BAND_BYTES_PER_ROW_PIXEL))
+    return max(_BAND_MIN, min(_BAND_MAX, band - band % 2))
+
+
 def banded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
-                       band: int = 256):
+                       band=None):
     """``_Trunk`` (downsample=2) on the same parameter tree, full-resolution
-    stages streamed in bands.  Returns the 1/4-resolution trunk output."""
+    stages streamed in bands.  Returns the 1/4-resolution trunk output.
+    ``band=None`` derives the band height from device HBM
+    (:func:`default_band_rows`)."""
     n, h, w, _ = x.shape
+    if band is None:
+        band = default_band_rows(n, w)
     assert band % 2 == 0, "band must be even for stride-2 alignment"
     nb = -(-h // band)
     xp = jnp.pad(x, ((0, 0), (_HALO, nb * band - h + _HALO), (0, 0), (0, 0)))

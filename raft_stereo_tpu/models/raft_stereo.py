@@ -31,11 +31,34 @@ from raft_stereo_tpu.models.update import BasicMultiUpdateBlock
 from raft_stereo_tpu.ops.grids import coords_grid_x
 from raft_stereo_tpu.ops.upsample import convex_upsample
 
-# Above this pixel count, fnet processes the two images sequentially instead
-# of as one batch-2 concat, halving the full-resolution stem's peak HBM
-# (KITTI/SceneFlow shapes stay on the batched path; Middlebury-F-class
-# frames take the sequential one).
-_SEQUENTIAL_FNET_PIXELS = 1_500_000
+# Extra peak-HBM bytes PER PIXEL the batch-2 fnet concat costs over the
+# sequential path when the stem runs at full resolution (n_downsample<=2):
+# XLA holds both images' full-resolution stem working sets live at once.
+# Measured on the TPU v5 lite chip via tools/fullres_gates.py (peak-HBM
+# difference of the two paths, bf16 instance-norm fnet, divided by pixels;
+# stable within ~3% across 0.5-2.2 MPix shapes).
+_STEM_EXTRA_BYTES_PER_PIXEL = 1100
+# Fraction of device HBM the batched path's EXTRA working set may occupy
+# before the sequential path is chosen.  With the measured bytes/pixel and
+# a 16 GiB chip this lands the threshold at ~1.5 MPix — KITTI/SceneFlow
+# shapes stay batched, Middlebury-F-class frames go sequential (the
+# gate that first made 16.5 MPix frames fit in round 2).
+_SEQ_FNET_HBM_FRACTION = 0.10
+
+
+def sequential_fnet_threshold(cfg: RaftStereoConfig) -> int:
+    """Pixel count above which fnet runs the two images sequentially.
+
+    ``cfg.sequential_fnet_pixels`` overrides; otherwise derived from the
+    device's HBM so bigger chips keep the (latency-equal, see
+    docs/TRAIN_PROFILE.md round 3) batched path longer and smaller chips
+    fall back sooner: threshold = fraction * HBM / measured extra
+    bytes-per-pixel."""
+    if cfg.sequential_fnet_pixels is not None:
+        return cfg.sequential_fnet_pixels
+    from raft_stereo_tpu.profiling import device_hbm_bytes
+    return int(_SEQ_FNET_HBM_FRACTION * device_hbm_bytes()
+               / _STEM_EXTRA_BYTES_PER_PIXEL)
 
 
 class RAFTStereo(nn.Module):
@@ -107,7 +130,7 @@ class RAFTStereo(nn.Module):
                 return banded_trunk_apply(
                     mvars["params"]["trunk"],
                     mvars.get("batch_stats", {}).get("trunk", {}),
-                    x, norm_fn, dtype)
+                    x, norm_fn, dtype, band=cfg.band_rows)
 
         if cfg.shared_backbone:
             both = jnp.concatenate([image1, image2], axis=0)
@@ -120,7 +143,7 @@ class RAFTStereo(nn.Module):
             fmap = self.conv2_out(self.conv2_res(v))
             fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
         elif (use_banded or image1.shape[1] * image1.shape[2]
-                >= _SEQUENTIAL_FNET_PIXELS):
+                >= sequential_fnet_threshold(cfg)):
             # Full-resolution inputs: the stem runs at FULL image resolution
             # when n_downsample <= 2 (matching the reference's stride gate,
             # core/extractor.py:140), so its activations dominate peak HBM.

@@ -36,7 +36,14 @@ class FlowHead(nn.Module):
 
 
 class ConvGRU(nn.Module):
-    """ConvGRU with pre-computed context biases (reference: core/update.py:16-32)."""
+    """ConvGRU with pre-computed context biases (reference: core/update.py:16-32).
+
+    The z and r gates both convolve the same ``[h, x]`` concat, so they run
+    as ONE conv producing ``2*hidden`` channels, split afterwards — half the
+    conv dispatches in the scan body's hottest block for identical math (the
+    reference keeps two convs, core/update.py:18-19; the torch importer
+    concatenates their weights into ``convzr`` so checkpoints stay
+    compatible).  q cannot join: its input ``[r*h, x]`` depends on r."""
 
     hidden_dim: int
     kernel_size: int = 3
@@ -48,10 +55,10 @@ class ConvGRU(nn.Module):
         x = jnp.concatenate(x_list, axis=-1)
         hx = jnp.concatenate([h, x], axis=-1)
         k = self.kernel_size
-        z = nn.sigmoid(conv(self.hidden_dim, k, 1, dtype=self.dtype,
-                            name="convz")(hx) + cz)
-        r = nn.sigmoid(conv(self.hidden_dim, k, 1, dtype=self.dtype,
-                            name="convr")(hx) + cr)
+        zr = conv(2 * self.hidden_dim, k, 1, dtype=self.dtype,
+                  name="convzr")(hx)
+        z = nn.sigmoid(zr[..., :self.hidden_dim] + cz)
+        r = nn.sigmoid(zr[..., self.hidden_dim:] + cr)
         q = nn.tanh(conv(self.hidden_dim, k, 1, dtype=self.dtype, name="convq")(
             jnp.concatenate([r * h, x], axis=-1)) + cq)
         return (1 - z) * h + z * q

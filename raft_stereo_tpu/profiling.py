@@ -56,6 +56,18 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
     return dict(stats) if stats else {}
 
 
+def device_hbm_bytes(fallback: int = 16 * 2 ** 30) -> int:
+    """Accelerator memory capacity; ``fallback`` when the backend doesn't
+    report one (CPU test runs).  Basis for the memory-derived full-res
+    gates (models/raft_stereo.sequential_fnet_threshold,
+    models/banded.default_band_rows)."""
+    try:
+        limit = int(device_memory_stats().get("bytes_limit", 0))
+    except Exception:  # pragma: no cover - backend without device queries
+        limit = 0
+    return limit if limit > 0 else fallback
+
+
 @dataclass
 class FpsResult:
     fps: float
@@ -84,7 +96,12 @@ class FpsProtocol:
         for args in inputs:
             t0 = time.perf_counter()
             out = fn(*args)
-            jax.block_until_ready(out)
+            # A REAL device->host transfer is the only honest stop clock on
+            # this hardware: jax.block_until_ready returns at DISPATCH
+            # behind the async device tunnel (measured, bench.py:9-14).
+            # device_get is a no-op on the NumPy outputs of already-honest
+            # callables (e.g. eval.runner.InferenceRunner).
+            jax.device_get(out)
             elapsed = time.perf_counter() - t0
             n += 1
             if n > self.warmup:

@@ -14,16 +14,76 @@ making exact resume impossible.  Here a checkpoint directory holds:
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from raft_stereo_tpu.config import RaftStereoConfig
 
+log = logging.getLogger(__name__)
+
 CONFIG_FILE = "config.json"
 STATE_DIR = "state"
+
+
+# ---------------------------------------------------------------- migration
+# Round 3 fused ConvGRU's separate convz/convr gate convs into one ``convzr``
+# (models/update.py).  Checkpoints saved before that carry the split layout —
+# in params AND in the AdamW moment subtrees mirroring them — and are
+# migrated transparently on restore.
+
+def _map_dict_nodes(f, tree):
+    """Apply ``f`` to every dict node of a pytree, bottom-up, preserving
+    list/tuple/namedtuple containers (optax states are namedtuples whose
+    fields hold param-shaped dicts)."""
+    if isinstance(tree, dict):
+        return f({k: _map_dict_nodes(f, v) for k, v in tree.items()})
+    if isinstance(tree, (list, tuple)):
+        vals = [_map_dict_nodes(f, v) for v in tree]
+        return (type(tree)(*vals) if hasattr(tree, "_fields")
+                else type(tree)(vals))
+    return tree
+
+
+def _is_conv_leaves(node) -> bool:
+    return (isinstance(node, dict) and set(node) == {"kernel", "bias"}
+            and all(hasattr(v, "shape") for v in node.values()))
+
+
+def _split_convzr(tree):
+    """New layout -> legacy: split fused convzr params (kernel HWIO last
+    axis = output channels; z first, matching ConvGRU's split order)."""
+    def split(node):
+        zr = node.get("convzr")
+        if _is_conv_leaves(zr) and "convz" not in node:
+            node = dict(node)
+            del node["convzr"]
+            k, b = np.asarray(zr["kernel"]), np.asarray(zr["bias"])
+            half = b.shape[0] // 2
+            node["convz"] = {"kernel": k[..., :half], "bias": b[:half]}
+            node["convr"] = {"kernel": k[..., half:], "bias": b[half:]}
+        return node
+    return _map_dict_nodes(split, tree)
+
+
+def _merge_convzr(tree):
+    """Legacy -> new layout: concatenate convz/convr back into convzr."""
+    def merge(node):
+        z, r = node.get("convz"), node.get("convr")
+        if _is_conv_leaves(z) and _is_conv_leaves(r) and "convzr" not in node:
+            node = dict(node)
+            del node["convz"], node["convr"]
+            node["convzr"] = {
+                "kernel": np.concatenate([np.asarray(z["kernel"]),
+                                          np.asarray(r["kernel"])], axis=-1),
+                "bias": np.concatenate([np.asarray(z["bias"]),
+                                        np.asarray(r["bias"])], axis=0)}
+        return node
+    return _map_dict_nodes(merge, tree)
 
 
 def _abs(path: str) -> str:
@@ -61,9 +121,22 @@ def load_checkpoint(path: str, target: Optional[Any] = None
     ckptr = ocp.StandardCheckpointer()
     state_path = os.path.join(path, STATE_DIR)
     if target is not None:
-        restored = ckptr.restore(state_path, target=jax.device_get(target))
+        target = jax.device_get(target)
+        try:
+            restored = ckptr.restore(state_path, target=target)
+        except Exception:
+            # Structure mismatch: retry against the pre-round-3 split-gate
+            # layout and merge back (no-op split -> nothing legacy to match
+            # -> the original error class re-raises from this restore).
+            legacy = _split_convzr(target)
+            restored = _merge_convzr(
+                ckptr.restore(state_path, target=legacy))
+            log.info("migrated legacy convz/convr checkpoint %s to the "
+                     "fused convzr layout", path)
     else:
-        restored = ckptr.restore(state_path)
+        # Raw restores (inference exports) migrate unconditionally —
+        # a no-op on post-round-3 checkpoints.
+        restored = _merge_convzr(ckptr.restore(state_path))
     return cfg, restored
 
 

@@ -28,8 +28,10 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimization step.
 
-    ``batch``: image1/image2 (B,H,W,3) float32 0..255, flow (B,H,W) x-flow
-    (= -disparity), valid (B,H,W) in {0,1}.
+    ``batch``: image1/image2 (B,H,W,3) uint8 or float32 0..255 (the loader
+    ships uint8 to quarter the host->device transfer; the model normalizes
+    either on device), flow (B,H,W) x-flow (= -disparity), valid (B,H,W)
+    in {0,1}.
     """
 
     # Tolerate states built without create_train_state (batch_stats=None).

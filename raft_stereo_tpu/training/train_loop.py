@@ -38,6 +38,67 @@ from raft_stereo_tpu.training.step import make_train_step
 
 log = logging.getLogger(__name__)
 
+# Batches uploaded to the device ahead of the step dispatch (per-step HBM
+# cost: depth x batch bytes).  Behind a remote device tunnel the synchronous
+# upload alone added ~0.75 s/step at the SceneFlow config (bench_loader.py
+# combined run); prefetching overlaps it with device compute.
+_DEVICE_PREFETCH_DEPTH = 2
+
+
+class _DevicePrefetcher:
+    """Iterator wrapper that applies ``put`` (host->device upload / global
+    shard assembly) on a worker thread, ``depth`` batches ahead.
+
+    The wrapped iterator's exceptions re-raise in the consumer; exhaustion
+    yields the usual StopIteration so ``next(it, None)`` keeps feeding the
+    train loop's global stop collective."""
+
+    _DONE = object()
+
+    def __init__(self, it, put, depth: int = _DEVICE_PREFETCH_DEPTH):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+
+        def run():
+            try:
+                for item in it:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(put(item))
+            except BaseException as e:  # surface in the consumer
+                self._q.put(e)
+            else:
+                self._q.put(self._DONE)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        # unblock a producer waiting on a full queue, then wait for it to
+        # leave the JAX runtime — a daemon thread still inside device_put at
+        # interpreter teardown crashes the process exit.
+        while self._thread.is_alive():
+            while not self._q.empty():
+                try:
+                    self._q.get_nowait()
+                except Exception:  # pragma: no cover - raced drain
+                    break
+            self._thread.join(timeout=0.2)
+
 
 def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
           name: str = "raft-stereo",
@@ -203,8 +264,13 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         for m, lr in zip(fetched, lrs):
             logger.push(m, lr=float(lr))
 
+    # Host->device upload (or global shard assembly) runs on a prefetch
+    # thread, ahead of the step dispatch — the synchronous per-step upload
+    # is otherwise serial with compute (see _DevicePrefetcher).
+    put = ((lambda b: shard_batch(b, mesh)) if mesh is not None
+           else jax.device_put)
+    batches = _DevicePrefetcher(iter(loader), put)
     try:
-        batches = iter(loader)
         while True:
             # Fetch BEFORE the stop collective so loader exhaustion is part
             # of the global stop decision: any_process's call-count invariant
@@ -222,8 +288,6 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             if step >= total or distributed.any_process(
                     stop_requested or batch is None):
                 break
-            if mesh is not None:
-                batch = shard_batch(batch, mesh)
             state, metrics = step_fn(state, batch)
             step += 1
             pending_metrics.append(metrics)
@@ -253,6 +317,7 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             drain_metrics()
         except Exception:
             log.exception("could not drain buffered metrics")
+        batches.close()
         logger.close()
         _restore_handlers()
 

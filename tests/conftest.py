@@ -11,6 +11,10 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(__file__))
+# repo root too: tests import the root-level bench modules (e.g.
+# bench_loader's tree builder), which are tracked sources, so the suite
+# must resolve them when pytest is invoked from any directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 from _hermetic import force_cpu  # noqa: E402
 
 jax = force_cpu(8)

@@ -199,3 +199,39 @@ def test_loader_epoch_reshuffles(tmp_path):
     loader = StereoLoader(ds, batch_size=6, num_workers=0, seed=0, epochs=2)
     b1, b2 = list(loader)
     assert any(not np.array_equal(b1[k], b2[k]) for k in b1)
+
+
+def test_sceneflow_loader_decode_throughput(tmp_path):
+    """Guards the PFM+PNG decode -> DenseAugmentor -> batch path on the
+    SceneFlow disk layout (the training recipe's input, reference:
+    core/stereo_datasets.py:123-184).  Uses bench_loader's tree builder so
+    the benchmark and this guard can never drift apart; asserts correctness
+    and a very conservative throughput floor (the real demand check is
+    bench_loader.py on the bench host)."""
+    import time
+
+    from bench_loader import build_tree
+    from raft_stereo_tpu.data.datasets import SceneFlow
+
+    root = str(tmp_path / "sf")
+    build_tree(root, n_pairs=8, hw=(120, 200))
+    aug = {"crop_size": (96, 160), "min_scale": -0.2, "max_scale": 0.4,
+           "do_flip": None, "yjitter": True}
+    ds = SceneFlow(aug, root=root, dstype="frames_cleanpass")
+    assert len(ds) == 8
+    loader = StereoLoader(ds, batch_size=4, num_workers=2, seed=0, epochs=2)
+    t0 = time.perf_counter()
+    batches = list(loader)
+    dt = time.perf_counter() - t0
+    assert len(batches) == 4
+    b = batches[0]
+    assert b["image1"].shape == (4, 96, 160, 3)
+    assert b["image1"].dtype == np.uint8  # device-transfer-lean contract
+    assert b["flow"].shape == (4, 96, 160)
+    assert np.all(b["flow"] <= 0)  # x-flow = -disparity
+    assert set(np.unique(b["valid"])) <= {0.0, 1.0}
+    # 16 images decoded+augmented; a deliberately loose floor (locally
+    # ~10x above it) so only order-of-magnitude decode-path regressions
+    # fail, not a contended CI runner.  Real throughput-vs-demand evidence
+    # is bench_loader.py's job on the bench host.
+    assert 16 / dt > 2.0, f"decode path too slow: {16 / dt:.1f} images/s"

@@ -228,3 +228,49 @@ def test_train_rejects_more_corr_shards_than_devices():
     with pytest.raises(ValueError, match="exceeds"):
         train(RaftStereoConfig(corr_w2_shards=len(jax.devices()) * 2),
               TrainConfig(batch_size=2, num_steps=1))
+
+
+def test_legacy_convzr_checkpoint_migrates(tmp_path):
+    """Checkpoints saved before the convz/convr -> convzr gate fusion
+    restore transparently: the loader retries against the split-gate layout
+    and merges the halves back (params AND AdamW moment subtrees)."""
+    from raft_stereo_tpu.training import checkpoint as ckpt
+    from raft_stereo_tpu.training.checkpoint import (_merge_convzr,
+                                                     _split_convzr)
+    from raft_stereo_tpu.training.state import create_train_state
+
+    mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), corr_levels=2,
+                            fnet_dim=64)
+    tcfg = TrainConfig(batch_size=2, train_iters=1, num_steps=10,
+                       image_size=(32, 64))
+    state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                               (1, 32, 64, 3))
+    tree = {"params": jax.device_get(state.params),
+            "batch_stats": jax.device_get(state.batch_stats) or {},
+            "opt_state": jax.device_get(state.opt_state),
+            "step": np.asarray(0)}
+
+    # Simulate a pre-fusion checkpoint: save the SPLIT layout.
+    legacy_tree = _split_convzr(tree)
+    flat = jax.tree_util.tree_leaves_with_path(legacy_tree["params"])
+    assert any("convz" in jax.tree_util.keystr(p) for p, _ in flat)
+    path = str(tmp_path / "legacy")
+    ckpt.save_checkpoint(path, mcfg, legacy_tree)
+
+    _, restored = ckpt.load_checkpoint(path, target=tree)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(tree),
+            jax.tree_util.tree_leaves_with_path(restored)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+    # Raw (targetless) restores migrate too, and split/merge round-trips.
+    _, raw = ckpt.load_checkpoint(path)
+    flat_raw = jax.tree_util.tree_leaves_with_path(raw["params"])
+    assert not any("convz'" in jax.tree_util.keystr(p) for p, _ in flat_raw)
+    merged = _merge_convzr(_split_convzr(tree))
+    for (pa, a), (pb, b) in zip(jax.tree_util.tree_leaves_with_path(tree),
+                                jax.tree_util.tree_leaves_with_path(merged)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
