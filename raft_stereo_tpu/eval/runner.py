@@ -54,6 +54,15 @@ class InferenceRunner:
         self._compiled: Dict[Tuple[int, int], any] = {}
 
     def _forward_for(self, padded_hw: Tuple[int, int]):
+        """One compiled program per PADDED shape covering cast -> forward.
+
+        Keyed by the padded shape so distinct raw shapes that pad to the
+        same grid share one executable (real KITTI-2015 mixes 375x1242 /
+        370x1224 / 376x1241 — all 384x1248 padded; a raw-shape key would
+        compile each).  Padding/unpadding happen on the HOST in NumPy: the
+        device sees exactly one dispatch per image, which matters because
+        on a remote-tunneled device per-op host round-trips — not compute —
+        dominate the per-image product path (bench_product.py)."""
         if padded_hw not in self._compiled:
             while len(self._compiled) >= self.max_cached_shapes:
                 # dicts iterate in insertion order -> drop the oldest
@@ -62,8 +71,11 @@ class InferenceRunner:
 
             @jax.jit
             def fwd(variables, image1, image2):
-                return model.apply(variables, image1, image2, iters=iters,
-                                   test_mode=True)
+                img1 = image1.astype(jnp.float32)[None]
+                img2 = image2.astype(jnp.float32)[None]
+                _, flow_up = model.apply(variables, img1, img2, iters=iters,
+                                         test_mode=True)
+                return flow_up[0]
 
             self._compiled[padded_hw] = fwd
         else:  # LRU refresh
@@ -86,15 +98,21 @@ class InferenceRunner:
         (reference: evaluate_stereo.py:77-82)."""
         assert image1.ndim == 3 and image1.shape == image2.shape
         t0 = time.perf_counter()
-        img1 = jnp.asarray(image1, jnp.float32)[None]
-        img2 = jnp.asarray(image2, jnp.float32)[None]
-        padder = InputPadder(img1.shape, divis_by=self.divis_by)
-        img1, img2 = padder.pad(img1, img2)
-        fwd = self._forward_for(img1.shape[1:3])
-        _, flow_up = fwd(self.variables, img1, img2)
-        flow = np.asarray(padder.unpad(flow_up)[0])
+        padder = InputPadder((1,) + image1.shape, divis_by=self.divis_by)
+        l, r, t, b = padder.pads
+        # Host-side replicate pad (NumPy — microseconds) and caller-dtype
+        # upload: KITTI/eval images arrive uint8, so the per-image copy is
+        # 4x smaller; the cast to float happens on device inside the
+        # compiled program.
+        spec = ((t, b), (l, r), (0, 0))
+        p1 = np.pad(np.asarray(image1), spec, mode="edge")
+        p2 = np.pad(np.asarray(image2), spec, mode="edge")
+        fwd = self._forward_for(p1.shape[:2])
+        flow_padded = np.asarray(fwd(self.variables, jnp.asarray(p1),
+                                     jnp.asarray(p2)))
+        flow = padder.unpad(flow_padded[None])[0]  # pure NumPy slicing
         elapsed = time.perf_counter() - t0
-        return flow, elapsed
+        return np.ascontiguousarray(flow), elapsed
 
     def disparity(self, image1: np.ndarray, image2: np.ndarray) -> np.ndarray:
         """Positive disparity map (the demo/user-facing convention,

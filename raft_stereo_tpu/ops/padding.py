@@ -25,6 +25,12 @@ class InputPadder:
         else:
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
 
+    @property
+    def pads(self):
+        """(left, right, top, bottom) pad amounts — for callers that pad
+        host-side (eval/runner.py) with the same layout semantics."""
+        return tuple(self._pad)
+
     def pad(self, *inputs):
         out = []
         for x in inputs:

@@ -52,8 +52,10 @@ def make_scenes():
         left = textured_image(rng, H, W)
         disp = disparity_field(rng, H, W)
         right = warp_right(left, disp)
-        scenes.append((left.astype(np.float32), right.astype(np.float32),
-                       -disp))
+        # uint8 images: the loader contract — and behind the remote device
+        # tunnel the per-step batch upload is the wall-clock bottleneck
+        # (docs/TRAIN_PROFILE.md), so a float32 stream would 4x it.
+        scenes.append((left, right, -disp))
     return scenes
 
 
@@ -81,6 +83,8 @@ def flat_params(state):
 
 
 def main():
+    import logging
+    logging.basicConfig(level=logging.INFO)  # step-rate visibility (SUM_FREQ)
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
 
