@@ -338,33 +338,52 @@ def _alt_multi_bwd(static, residuals, g):
 _alt_multi.defvjp(_alt_multi_fwd, _alt_multi_bwd)
 
 
+# Mosaic's scoped-vmem (kernel stack) limit is 16 MiB on this generation,
+# and its stack allocator does NOT reuse buffers across the unrolled level
+# loop of `_fwd_multi_kernel` — the live set is the per-level SUM.  One
+# hard calibration point: 544x960 fp32 (wcat=450, d=256) FAILS with a
+# measured 18.11 MiB scoped allocation where `_multi_alt_scoped_bytes`
+# estimates 14.71 MiB — the estimator runs ~1.23x low (compiler
+# temporaries it can't see).  The gate threshold therefore sits at
+# 16 MiB / 1.28 = 12.5 MiB of ESTIMATED bytes, so the worst gate-passing
+# program lands at ~12.5 * 1.23 = 15.4 MiB of real allocation, inside the
+# limit.  The realtime shape (wcat=292, bf16) estimates 10.39 MiB and is
+# proven to compile and run (bench.py r02/r03).
+_MOSAIC_SCOPED_VMEM = int(12.5 * 2 ** 20)
+
+
+def _multi_alt_scoped_bytes(w2s, d: int, itemsize: int, radius: int) -> int:
+    """Estimated Mosaic stack bytes of one `_fwd_multi_kernel` program:
+    double-buffered input blocks, fp32 upcast copies (free when the input
+    is already fp32), per-level volume + hat-field + product (all live —
+    no cross-level reuse), and the double-buffered output block."""
+    fp32 = 4
+    k = 2 * radius + 1
+    wcat = sum(w2s)
+    inputs = 2 * ROW_BLK * (wcat + W1_BLK) * d * itemsize
+    upcasts = (0 if itemsize == fp32
+               else ROW_BLK * (wcat + W1_BLK) * d * fp32)
+    per_level = ROW_BLK * W1_BLK * sum(
+        2 * w2 + (w2 + 2 * radius) for w2 in w2s) * fp32
+    out = 2 * ROW_BLK * W1_BLK * len(w2s) * k * fp32
+    return inputs + upcasts + per_level + out
+
+
 def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
                      coords: jnp.ndarray, radius: int) -> jnp.ndarray:
     """Fused no-volume window correlation at every level, concat level-major —
     drop-in for the XLA alt lookup in models/corr.py make_corr_fn_alt.
 
-    Uses the single-launch all-levels kernel when the concatenated right
-    features fit the per-tile VMEM budget; otherwise one launch per level."""
-    wcat = sum(f2.shape[2] for f2 in fmap2_pyramid)
+    Uses the single-launch all-levels kernel when the whole program's
+    Mosaic stack estimate fits the scoped-vmem limit; otherwise one launch
+    per level (which shrinks row blocks for full-res pyramids)."""
     d = fmap1.shape[-1]
-    w2_max = max(f2.shape[2] for f2 in fmap2_pyramid)
-    k = 2 * radius + 1
-    fp32 = 4  # the kernel upcasts to fp32 whatever the input dtype
-    working_set = (ROW_BLK * wcat * d * fp32          # f2cat upcast
-                   + ROW_BLK * W1_BLK * d * fp32      # f1 tile upcast
-                   + ROW_BLK * W1_BLK * w2_max * fp32  # largest volume tile
-                   # the hat-weight broadcast materializes at volume-tile
-                   # size before the contraction, and the output tile is
-                   # live across all levels
-                   + ROW_BLK * W1_BLK * w2_max * fp32
-                   + ROW_BLK * W1_BLK * len(fmap2_pyramid) * k * fp32)
-    # over the package-shared budget -> per-level launches (which
-    # shrink their row blocks for full-res pyramids)
-    if working_set <= VMEM_BUDGET:
+    w2s = [f2.shape[2] for f2 in fmap2_pyramid]
+    if (_multi_alt_scoped_bytes(w2s, d, fmap1.dtype.itemsize, radius)
+            <= _MOSAIC_SCOPED_VMEM):
         static = (radius,
-                  tuple(int(sum(f.shape[2] for f in fmap2_pyramid[:i]))
-                        for i in range(len(fmap2_pyramid))),
-                  tuple(int(f.shape[2]) for f in fmap2_pyramid))
+                  tuple(int(sum(w2s[:i])) for i in range(len(w2s))),
+                  tuple(int(w) for w in w2s))
         f2cat = jnp.concatenate(fmap2_pyramid, axis=2)
         return _alt_multi(fmap1, f2cat, coords, static)
 

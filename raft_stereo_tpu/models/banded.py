@@ -161,16 +161,19 @@ def _residual_block(tp, batch_stats, x, name, stride, norm_fn, dtype):
     return jnn.relu(x + y)
 
 
-# Peak-HBM bytes one band of the streaming segment holds live, per
-# (row x width-pixel x batch-sample): the haloed input band, the 64-channel
-# conv/norm intermediates of the deepest sweep, and their fp32 upcasts.
-# Measured on the TPU v5 lite chip via tools/fullres_gates.py (peak HBM of a
-# banded trunk forward minus baseline, divided by band rows x W).
-_BAND_BYTES_PER_ROW_PIXEL = 1536
-# Fraction of device HBM the resident band working set may occupy.  The
-# rest stays available for the off-band stages (1/2-res tail, correlation,
-# GRU state) that coexist with the streamed stem.
-_BAND_HBM_FRACTION = 1 / 16
+# Peak-HBM bytes one band of the streaming segment adds per
+# (row x width-pixel x batch-sample).  Measured on the TPU v5 lite chip via
+# tools/fullres_gates.py (FULLRES_GATES_r03.json): peak-HBM slope in band
+# height at 1984x2880 = 231.7 B/(row*width-pixel); the overall peak is
+# nearly FLAT in the band (3.93-4.20 GiB for bands 128-512) because the
+# off-band stages dominate, so the choice is low-stakes within the clamp.
+_BAND_BYTES_PER_ROW_PIXEL = 232
+# Fraction of device HBM the resident band working set may occupy — ~1%,
+# which reproduces the band=256 that carried the round-2 full-resolution
+# measurements (FULLRES_r02.json) at the 2880-wide calibration shape on a
+# 16 GiB chip; the rest stays for the off-band stages (1/2-res tail,
+# correlation, GRU state) that coexist with the streamed stem.
+_BAND_HBM_FRACTION = 1 / 96
 _BAND_MIN, _BAND_MAX = 64, 1024
 
 
@@ -178,8 +181,10 @@ def default_band_rows(n: int, w: int) -> int:
     """Band height derived from device HBM: the largest even band whose
     working set (``n * w * band * _BAND_BYTES_PER_ROW_PIXEL``) stays under
     ``_BAND_HBM_FRACTION`` of HBM, clamped to [64, 1024].  At W=2880 on a
-    16 GiB chip this reproduces the band=256 that carried the round-2
-    full-resolution measurements (FULLRES_r02.json)."""
+    16 GiB chip this lands at 266 rows — within 5% of the band=256 that
+    carried the round-2 full-resolution measurements (FULLRES_r02.json),
+    whose peak HBM the calibration run measured as nearly flat in the
+    band height anyway (FULLRES_GATES_r03.json)."""
     from raft_stereo_tpu.profiling import device_hbm_bytes
     budget = _BAND_HBM_FRACTION * device_hbm_bytes()
     band = int(budget // (max(n, 1) * w * _BAND_BYTES_PER_ROW_PIXEL))

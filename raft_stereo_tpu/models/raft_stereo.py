@@ -34,10 +34,13 @@ from raft_stereo_tpu.ops.upsample import convex_upsample
 # Extra peak-HBM bytes PER PIXEL the batch-2 fnet concat costs over the
 # sequential path when the stem runs at full resolution (n_downsample<=2):
 # XLA holds both images' full-resolution stem working sets live at once.
-# Measured on the TPU v5 lite chip via tools/fullres_gates.py (peak-HBM
-# difference of the two paths, bf16 instance-norm fnet, divided by pixels;
-# stable within ~3% across 0.5-2.2 MPix shapes).
-_STEM_EXTRA_BYTES_PER_PIXEL = 1100
+# Measured on the TPU v5 lite chip via tools/fullres_gates.py
+# (FULLRES_GATES_r03.json): 1190 / 1179 / 1166 B/px at 544x960 / 1088x1984
+# / 1984x2880 — stable within ~2%.  The same run measured the sequential
+# path's FPS cost as ZERO or better (-2..-11% i.e. sequential was FASTER
+# at every shape), so the gate only protects the batched path's
+# (historically assumed) scheduling advantage at small shapes.
+_STEM_EXTRA_BYTES_PER_PIXEL = 1180
 # Fraction of device HBM the batched path's EXTRA working set may occupy
 # before the sequential path is chosen.  With the measured bytes/pixel and
 # a 16 GiB chip this lands the threshold at ~1.5 MPix — KITTI/SceneFlow
@@ -50,10 +53,11 @@ def sequential_fnet_threshold(cfg: RaftStereoConfig) -> int:
     """Pixel count above which fnet runs the two images sequentially.
 
     ``cfg.sequential_fnet_pixels`` overrides; otherwise derived from the
-    device's HBM so bigger chips keep the (latency-equal, see
-    docs/TRAIN_PROFILE.md round 3) batched path longer and smaller chips
-    fall back sooner: threshold = fraction * HBM / measured extra
-    bytes-per-pixel."""
+    device's HBM so bigger chips keep the batched path longer and smaller
+    chips fall back sooner: threshold = fraction * HBM / measured extra
+    bytes-per-pixel.  The sequential path's measured FPS cost is zero or
+    negative (FULLRES_GATES_r03.json), so the gate is purely a
+    memory-pressure decision."""
     if cfg.sequential_fnet_pixels is not None:
         return cfg.sequential_fnet_pixels
     from raft_stereo_tpu.profiling import device_hbm_bytes

@@ -114,3 +114,18 @@ def test_alt_fused_model_forward(rng, _interpret_mode):
     lo, up = model.apply(v, img1, img2, iters=2, test_mode=True)
     assert up.shape == (1, 32, 64)
     assert np.isfinite(np.asarray(up)).all()
+
+
+def test_multi_alt_gate_tracks_mosaic_stack():
+    """The single-launch multi-level gate models Mosaic's no-reuse stack:
+    the 544x960 fp32 accuracy shape (wcat=450, d=256) measured 18.11 MiB
+    scoped and FAILED to compile, so the gate must route it per-level; the
+    realtime KITTI shape (bf16, wcat=292) compiles (~12 MiB) and must stay
+    on the fast multi path."""
+    from raft_stereo_tpu.kernels.corr_alt import (_MOSAIC_SCOPED_VMEM,
+                                                  _multi_alt_scoped_bytes)
+
+    full_fp32 = _multi_alt_scoped_bytes([240, 120, 60, 30], 256, 4, 4)
+    assert full_fp32 > _MOSAIC_SCOPED_VMEM, full_fp32
+    realtime_bf16 = _multi_alt_scoped_bytes([156, 78, 39, 19], 256, 2, 4)
+    assert realtime_bf16 <= _MOSAIC_SCOPED_VMEM, realtime_bf16

@@ -26,10 +26,14 @@ import argparse
 import dataclasses
 import functools
 import json
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MEM_SHAPES = ((544, 960), (1088, 1984), (1984, 2880))
 FPS_SHAPES = ((384, 1248), (544, 960), (1088, 1984))  # KITTI, SceneFlow, full-res
