@@ -114,6 +114,29 @@ class FpsProtocol:
                          n_timed=len(times))
 
 
+def make_forward_chain(apply_fn: Callable, variables, img1, img2):
+    """The standard on-device forward chain for ``chained_seconds_per_call``:
+    K calls of ``apply_fn(variables, image1, image2)`` inside a jitted
+    ``fori_loop`` (inputs perturbed per iteration so XLA can't fold the
+    loop), synced by a scalar ``float()`` fetch.  One canonical copy of the
+    perturbation/static-argnum/scalar-fetch scaffolding the bench scripts
+    share — see ``chained_seconds_per_call`` for the timing pitfalls it
+    guards against."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def chain(variables, a, b, k):
+        def body(i, acc):
+            out = apply_fn(variables, a + i * 1e-6, b)
+            return acc + jnp.mean(out)
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    return lambda k: (lambda: float(chain(variables, img1, img2, k)))
+
+
 def chained_seconds_per_call(make_chain: Callable[[int], Callable[[], object]],
                              k_lo: int = 3, k_hi: int = 23,
                              repeats: int = 3,
