@@ -25,7 +25,6 @@ roofline probes quantify it); compare trends, not single runs.  North star
 
 from __future__ import annotations
 
-import functools
 import json
 
 import jax
@@ -55,20 +54,14 @@ def main():
                              iters=1, test_mode=True)
     )(jax.random.PRNGKey(0))
 
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def chain(variables, image1, image2, k):
-        def body(i, acc):
-            _, up = model.apply(variables, image1 + i * 1e-6, image2,
-                                iters=7, test_mode=True)
-            return acc + jnp.mean(up)
-        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+    from raft_stereo_tpu.profiling import (chained_seconds_per_call,
+                                           make_forward_chain)
 
-    from raft_stereo_tpu.profiling import chained_seconds_per_call
-
-    def make_chain(k):
-        # scalar float() fetch = full sync even behind the async tunnel
-        return lambda: float(chain(variables, img1, img2, k))
-
+    # scalar float() fetch inside the chain = full sync even behind the
+    # async tunnel (see profiling.make_forward_chain)
+    make_chain = make_forward_chain(
+        lambda v, a, b: model.apply(v, a, b, iters=7, test_mode=True)[1],
+        variables, img1, img2)
     per_image = chained_seconds_per_call(make_chain, k_lo=K_LO, k_hi=K_HI,
                                          repeats=REPEATS)
     fps = 1.0 / per_image

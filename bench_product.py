@@ -16,7 +16,6 @@ Python/dispatch/copy overhead on this host).
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import sys
@@ -43,7 +42,8 @@ def main():
     from raft_stereo_tpu.eval.runner import InferenceRunner
     from raft_stereo_tpu.eval.validate import validate_kitti
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
-    from raft_stereo_tpu.profiling import chained_seconds_per_call
+    from raft_stereo_tpu.profiling import (chained_seconds_per_call,
+                                           make_forward_chain)
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
@@ -69,16 +69,11 @@ def main():
     img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
     img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
 
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def chain(variables, image1, image2, k):
-        def body(i, acc):
-            _, up = model.apply(variables, image1 + i * 1e-6, image2,
-                                iters=ITERS, test_mode=True)
-            return acc + jnp.mean(up)
-        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
-
     bare_s = chained_seconds_per_call(
-        lambda k: (lambda: float(chain(variables, img1, img2, k))),
+        make_forward_chain(
+            lambda v, a, b: model.apply(v, a, b, iters=ITERS,
+                                        test_mode=True)[1],
+            variables, img1, img2),
         k_lo=K_LO, k_hi=K_HI, repeats=REPEATS)
 
     # --- decompose the per-image overhead: device round-trip latency and

@@ -154,3 +154,10 @@ class StereoLoader:
                 yield batch
         finally:
             done.set()
+            # Collect the workers (they poll `done` every 0.1 s): a daemon
+            # thread still inside the native decoder at interpreter
+            # teardown aborts the process ("terminate called without an
+            # active exception"); bounded joins close that window without
+            # risking a hang on a stuck decode.
+            for t in threads:
+                t.join(timeout=2.0)

@@ -20,12 +20,14 @@ evaluate_stereo.py:77-82,105-107).  This module makes both first-class:
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 import time
 from dataclasses import dataclass
 from typing import Callable, Iterable, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -118,14 +120,12 @@ def make_forward_chain(apply_fn: Callable, variables, img1, img2):
     """The standard on-device forward chain for ``chained_seconds_per_call``:
     K calls of ``apply_fn(variables, image1, image2)`` inside a jitted
     ``fori_loop`` (inputs perturbed per iteration so XLA can't fold the
-    loop), synced by a scalar ``float()`` fetch.  One canonical copy of the
-    perturbation/static-argnum/scalar-fetch scaffolding the bench scripts
-    share — see ``chained_seconds_per_call`` for the timing pitfalls it
-    guards against."""
-    import functools
-
-    import jax
-    import jax.numpy as jnp
+    loop), synced by a scalar ``float()`` fetch.  The one canonical copy of
+    this scaffolding, used by bench.py / bench_product.py /
+    tools/inference_profile.py (bench_fullres.py and tools/fullres_gates.py
+    keep inline chains because the same compiled program doubles as their
+    ``memory_analysis`` subject) — see ``chained_seconds_per_call`` for the
+    timing pitfalls it guards against."""
 
     @functools.partial(jax.jit, static_argnums=(3,))
     def chain(variables, a, b, k):
