@@ -80,6 +80,34 @@ def _subtree(tree, path):
     return tree
 
 
+def masked_moments(t, m, width):
+    """Per-(sample, channel) mean and sum of squared deviations of ``t``
+    (N, rows, W, C) over the rows selected by broadcastable bool mask ``m``,
+    plus the element count.  The two-pass (mean, then M2) form — the
+    one-pass E[x²]−mean² formula cancels catastrophically at many-MPix
+    pixel counts in fp32."""
+    t = jnp.where(m, t.astype(jnp.float32), 0.0)
+    n = jnp.sum(m.astype(jnp.float32)) * width
+    mean = jnp.sum(t, axis=(1, 2)) / n                       # (N, C)
+    dev = jnp.where(m, t - mean[:, None, None, :], 0.0)
+    m2 = jnp.sum(dev * dev, axis=(1, 2))
+    return mean, m2, n
+
+
+def chan_combine(means, m2s, ns):
+    """Chan's parallel-variance combination of stacked per-chunk moments
+    (k, N, C)/(k, N, C)/(k,) → global ``(mean, var)`` of shape (N, C).
+    Shared by the banded executor (chunks = bands) and the row-sharded
+    executor (chunks = devices, via all_gather) so the numerically
+    delicate combination can never diverge between them."""
+    total = jnp.sum(ns)
+    mean = jnp.sum(means * ns[:, None, None], axis=0) / total
+    m2 = (jnp.sum(m2s, axis=0)
+          + jnp.sum(ns[:, None, None]
+                    * jnp.square(means - mean[None]), axis=0))
+    return mean, m2 / total
+
+
 def _norm(norm_fn, tp, batch_stats, path, dtype, inst_stats, x):
     """Norm at ``path``: instance uses ``inst_stats`` when given (banded
     segment) else full-tensor stats; batch/none are elementwise."""
@@ -103,7 +131,11 @@ def _segment(tp, batch_stats, xb, norm_fn, dtype, stats, upto, row_mask):
 
     ``upto`` ∈ 1..5 returns instance-norm input t_upto (a stats sweep);
     ``upto`` = 6 returns layer2_0's two stride-2 conv outputs (final sweep).
-    ``stats``: per-norm (mean, var) tuples (instance norm only).
+    ``stats``: per-norm (mean, var) tuples (instance norm only), OR a
+    callable ``stats(k, t) -> (mean, var)`` computing norm ``k``'s global
+    statistics from its input on the fly (the row-sharded executor — each
+    device holds its whole slab, so a single pass pausing per norm for a
+    tiny cross-device moment exchange replaces banded's recompute sweeps).
     ``row_mask``: True where the band row lies INSIDE the image.  Every
     activation is masked with it: at image borders the halo rows would
     otherwise carry leaked conv outputs where the full-image computation
@@ -113,8 +145,11 @@ def _segment(tp, batch_stats, xb, norm_fn, dtype, stats, upto, row_mask):
     m = row_mask[None, :, None, None]
 
     def norm(i, path, t):
-        return _norm(norm_fn, tp, batch_stats, path, dtype,
-                     stats[i] if stats else None, t)
+        if callable(stats):
+            s = stats(i, t)
+        else:
+            s = stats[i] if stats else None
+        return _norm(norm_fn, tp, batch_stats, path, dtype, s, t)
 
     t1 = _conv(tp["conv1"], xb, 1, dtype)
     if upto == 1:
@@ -223,26 +258,12 @@ def banded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
                 xb, bi = args
                 t = _segment(trunk_params, batch_stats, xb, norm_fn, dtype,
                              stats, upto=i, row_mask=row_mask_for(bi))
-                t = t[:, _HALO:_HALO + band].astype(jnp.float32)
+                t = t[:, _HALO:_HALO + band]
                 rows = jnp.arange(band)
                 m = ((rows + bi * band) < h)[None, :, None, None]
-                t = jnp.where(m, t, 0.0)
-                n_band = jnp.sum(m.astype(jnp.float32)) * w
-                # per-band mean + sum of squared deviations (masked), for
-                # Chan's parallel-variance combination below — the one-pass
-                # E[x²]-mean² formula cancels catastrophically at many-MPix
-                # pixel counts in fp32.
-                bmean = jnp.sum(t, axis=(1, 2)) / n_band         # (N, C)
-                dev = jnp.where(m, t - bmean[:, None, None, :], 0.0)
-                m2 = jnp.sum(dev * dev, axis=(1, 2))
-                return bmean, m2, n_band
+                return masked_moments(t, m, w)
             bmeans, m2s, ns = jax.lax.map(stat_band, (bands, band_idx))
-            total = jnp.sum(ns)                                   # = h*w
-            mean = jnp.sum(bmeans * ns[:, None, None], axis=0) / total
-            m2 = (jnp.sum(m2s, axis=0)
-                  + jnp.sum(ns[:, None, None]
-                            * jnp.square(bmeans - mean[None]), axis=0))
-            var = m2 / total
+            mean, var = chan_combine(bmeans, m2s, ns)  # Σns = h*w
             stats.append((mean[:, None, None, :], var[:, None, None, :]))
 
     @jax.checkpoint
@@ -261,8 +282,15 @@ def banded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
         return t.reshape(n, nb * (band // 2), *t.shape[3:])[:, :h2]
 
     u, v = unband(u_b), unband(v_b)
+    return trunk_tail(trunk_params, batch_stats, u, v, norm_fn, dtype)
 
-    # ---- layer2_0 tail + layer2_1 + layer3 at <= 1/2 resolution.
+
+def trunk_tail(trunk_params, batch_stats, u, v, norm_fn, dtype):
+    """layer2_0 tail + layer2_1 + layer3 at <= 1/2 resolution, from the
+    full-resolution segment's two stride-2 outputs (``_segment`` upto=6).
+    Shared by the banded executor above and the row-sharded executor
+    (parallel/rows_sharded.py) — both stream/shard only the full-res
+    segment and run this cheap tail on the assembled 1/2-res tensors."""
     l20 = trunk_params["layer2_0"]
     l20_b = _subtree(batch_stats, ("layer2_0",))
 
