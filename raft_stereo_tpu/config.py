@@ -86,6 +86,17 @@ class RaftStereoConfig:
     # Extension beyond the reference: shard the W2 (disparity-search) axis of
     # the correlation volume across a mesh axis for full-res inputs.
     corr_w2_shards: int = 1
+    # Extension beyond the reference: shard the IMAGE-ROW axis of the
+    # encoders' full-resolution segment across a mesh axis (context
+    # parallelism — parallel/rows_sharded.py): each device holds 1/N of the
+    # full-res stem activations.  INFERENCE/EVAL scope: trace the forward
+    # under ``parallel.rows_sharded.rows_sharding(mesh)``; the train loop
+    # does NOT auto-wire it (its data axis carries the batch — rows
+    # sharding there would need a dedicated mesh axis and is untested for
+    # training).  Supported for the same trunks as banded_encoder
+    # (n_downsample=2, instance/batch/none norms); incompatible with
+    # banded_encoder (pick streaming OR sharding for the segment).
+    rows_shards: int = 1
     # Pixel count above which fnet processes the two images sequentially
     # instead of as one batch-2 concat (halves the full-resolution stem's
     # peak HBM).  None = derive from the local device's HBM at trace time
@@ -118,6 +129,10 @@ class RaftStereoConfig:
             raise ValueError(
                 f"band_rows={self.band_rows} must be an even integer >= 2 "
                 f"(stride-2 alignment of the banded encoder)")
+        if self.rows_shards > 1 and self.banded_encoder:
+            raise ValueError(
+                "rows_shards and banded_encoder both replace the "
+                "full-resolution segment's executor — enable at most one")
         if self.corr_w2_shards > 1 and self.corr_backend == "alt":
             raise ValueError(
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "

@@ -117,36 +117,64 @@ class RAFTStereo(nn.Module):
         image1 = (2 * (image1 / 255.0) - 1.0).astype(dtype)
         image2 = (2 * (image2 / 255.0) - 1.0).astype(dtype)
 
+        # Alternative executors for the encoders' full-resolution segment:
+        # banded streams it (one-chip memory ceiling), rows-sharded splits
+        # it across a mesh axis (context parallelism).  Both inject through
+        # the same trunk_out hook on the SAME parameter tree.
         use_banded = (cfg.banded_encoder and not self.is_initializing())
-        if use_banded:
-            from raft_stereo_tpu.models.banded import (banded_supported,
-                                                       banded_trunk_apply)
+        use_rows = (cfg.rows_shards > 1 and not self.is_initializing())
+        custom_trunk = None
+        if use_banded or use_rows:
+            from raft_stereo_tpu.models.banded import banded_supported
             for norm in (cfg.context_norm,
                          *((cfg.fnet_norm,) if not cfg.shared_backbone
                            else ())):
                 if not banded_supported(norm, cfg.n_downsample):
                     raise ValueError(
-                        f"banded_encoder: norm {norm!r} with "
+                        f"banded_encoder/rows_shards: norm {norm!r} with "
                         f"n_downsample={cfg.n_downsample} is unsupported")
+        if use_banded:
+            from raft_stereo_tpu.models.banded import banded_trunk_apply
 
-            def banded_trunk(module, x, norm_fn):
+            def custom_trunk(module, x, norm_fn):
                 mvars = module.variables
                 return banded_trunk_apply(
                     mvars["params"]["trunk"],
                     mvars.get("batch_stats", {}).get("trunk", {}),
                     x, norm_fn, dtype, band=cfg.band_rows)
+        elif use_rows:
+            from raft_stereo_tpu.parallel.rows_sharded import (
+                active_rows_mesh, rows_sharded_trunk_apply)
+            active = active_rows_mesh()
+            if active is None:
+                raise RuntimeError(
+                    f"rows_shards={cfg.rows_shards} needs an active mesh: "
+                    "trace the model under "
+                    "parallel.rows_sharded.rows_sharding(mesh)")
+            rows_mesh, rows_axis = active
+            if rows_mesh.shape[rows_axis] != cfg.rows_shards:
+                raise ValueError(
+                    f"rows_shards={cfg.rows_shards} != mesh axis "
+                    f"{rows_axis!r} size {rows_mesh.shape[rows_axis]}")
+
+            def custom_trunk(module, x, norm_fn):
+                mvars = module.variables
+                return rows_sharded_trunk_apply(
+                    mvars["params"]["trunk"],
+                    mvars.get("batch_stats", {}).get("trunk", {}),
+                    x, norm_fn, dtype, mesh=rows_mesh, axis=rows_axis)
 
         if cfg.shared_backbone:
             both = jnp.concatenate([image1, image2], axis=0)
-            if use_banded:
+            if custom_trunk is not None:
                 levels, v = self.cnet(
-                    both, trunk_out=banded_trunk(self.cnet, both,
+                    both, trunk_out=custom_trunk(self.cnet, both,
                                                  cfg.context_norm))
             else:
                 levels, v = self.cnet(both)
             fmap = self.conv2_out(self.conv2_res(v))
             fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
-        elif (use_banded or image1.shape[1] * image1.shape[2]
+        elif (custom_trunk is not None or image1.shape[1] * image1.shape[2]
                 >= sequential_fnet_threshold(cfg)):
             # Full-resolution inputs: the stem runs at FULL image resolution
             # when n_downsample <= 2 (matching the reference's stride gate,
@@ -158,13 +186,13 @@ class RAFTStereo(nn.Module):
             # With banded_encoder, each trunk additionally streams its
             # full-resolution stages band by band (models/banded.py).
             levels, _ = self.cnet(
-                image1, trunk_out=banded_trunk(self.cnet, image1,
+                image1, trunk_out=custom_trunk(self.cnet, image1,
                                                cfg.context_norm)
-                if use_banded else None)
+                if custom_trunk is not None else None)
 
             def fnet_one(module, carry, img):
-                trunk_out = (banded_trunk(module.fnet, img, cfg.fnet_norm)
-                             if use_banded else None)
+                trunk_out = (custom_trunk(module.fnet, img, cfg.fnet_norm)
+                             if custom_trunk is not None else None)
                 return carry, module.fnet(img, trunk_out=trunk_out)
 
             fnet_scan = nn.scan(fnet_one,

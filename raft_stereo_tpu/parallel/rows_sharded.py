@@ -31,7 +31,9 @@ volume — core/corr.py:64-107); this module is capability beyond it.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +48,30 @@ from raft_stereo_tpu.parallel.mesh import DATA_AXIS
 # stride-2 entry = 8 rows, models/banded._HALO) — 16 gives 2x margin and
 # stays stride-2/4-aligned.
 DEFAULT_HALO = 2 * _HALO
+
+_active: Optional[Tuple[Mesh, str]] = None
+
+
+@contextlib.contextmanager
+def rows_sharding(mesh: Mesh, axis: str = DATA_AXIS):
+    """Activate ``(mesh, axis)`` for row-sharded encoding within the block.
+
+    Wrap the *tracing* of any jitted function whose model config has
+    ``rows_shards > 1`` — the same pattern as
+    ``parallel.corr_sharded.corr_sharding``; the two compose on one mesh
+    (rows over one axis, disparity bins over the other)."""
+    global _active
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no {axis!r} axis")
+    prev, _active = _active, (mesh, axis)
+    try:
+        yield mesh
+    finally:
+        _active = prev
+
+
+def active_rows_mesh() -> Optional[Tuple[Mesh, str]]:
+    return _active
 
 
 def rows_sharded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,

@@ -70,3 +70,67 @@ def test_rows_sharded_validates_shapes(rng):
     with pytest.raises(ValueError, match="halo"):
         rows_sharded_trunk_apply(v["params"], {}, x64, "none", jnp.float32,
                                  mesh=_mesh(4), halo=32)
+
+
+@pytest.mark.slow
+def test_rows_sharded_model_matches_plain(rng):
+    """Full model with rows_shards=4 under rows_sharding(mesh) vs the plain
+    model — same params, near-identical disparity (fp reassociation only,
+    amplified by the untrained GRU like the banded/sharded comparisons)."""
+    import dataclasses
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
+
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)), jnp.float32)
+    cfg = RaftStereoConfig(n_gru_layers=2, hidden_dims=(48, 48))
+    model = RAFTStereo(cfg)
+    v = model.init(jax.random.PRNGKey(0), img1, img2, iters=1,
+                   test_mode=True)
+    _, up_ref = model.apply(v, img1, img2, iters=3, test_mode=True)
+
+    cfg_r = dataclasses.replace(cfg, rows_shards=4)
+    with rows_sharding(_mesh(4)):
+        _, up_r = jax.jit(
+            lambda v, a, b: RAFTStereo(cfg_r).apply(v, a, b, iters=3,
+                                                    test_mode=True)
+        )(v, img1, img2)
+    np.testing.assert_allclose(np.asarray(up_r), np.asarray(up_ref),
+                               rtol=1e-3, atol=5e-3)
+
+
+def test_rows_shards_config_validation():
+    import dataclasses
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    with pytest.raises(ValueError, match="at most one"):
+        RaftStereoConfig(rows_shards=2, banded_encoder=True)
+
+    # tracing without an active mesh raises with the fix-it instruction
+    cfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), corr_levels=2,
+                           fnet_dim=64, rows_shards=2)
+    model = RAFTStereo(cfg)
+    img = jnp.zeros((1, 32, 64, 3), jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), img, img, iters=1, test_mode=True)
+    with pytest.raises(RuntimeError, match="rows_sharding"):
+        model.apply(v, img, img, iters=1, test_mode=True)
+
+
+def test_rows_sharded_two_axis_mesh(rng):
+    """Rows sharded over 'data' while a 'corr' axis coexists on the same
+    mesh — the precondition for composing with the W2-sharded volume."""
+    from raft_stereo_tpu.parallel.mesh import make_mesh
+
+    trunk = _Trunk("instance", downsample=2, dtype=jnp.float32)
+    x = jnp.asarray(rng.uniform(-1, 1, (1, 64, 32, 3)), jnp.float32)
+    v = trunk.init(jax.random.PRNGKey(0), x)
+    want = np.asarray(trunk.apply(v, x))
+    mesh = make_mesh(n_data=4, n_corr=2)  # 8 devices, two axes
+    got = np.asarray(rows_sharded_trunk_apply(
+        v["params"], v.get("batch_stats", {}), x, "instance", jnp.float32,
+        mesh=mesh, axis="data", halo=16))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
