@@ -8,6 +8,8 @@ compilation the same way (reference: evaluate_stereo.py:77-82).
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import time
 from typing import Dict, Optional, Tuple
 
@@ -18,6 +20,15 @@ import numpy as np
 from raft_stereo_tpu.config import RaftStereoConfig
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 from raft_stereo_tpu.ops.padding import InputPadder
+
+log = logging.getLogger(__name__)
+
+# GRU-iteration depth at which bf16 correlation measurably drifts on TRAINED
+# weights: at iters=32 the per-pixel p99 reaches ~6.5-7 px with ΔEPE +0.04 px
+# (BF16_DRIFT_r03.json), while at the realtime depth (7) drift is ≤0.03 px
+# EPE.  Eval/demo runs at or past this depth flip the correlation features to
+# fp32 (everything else stays bf16) unless the caller opts out.
+DEEP_ITERS_FP32_CORR = 16
 
 
 class InferenceRunner:
@@ -30,14 +41,21 @@ class InferenceRunner:
     def __init__(self, config: RaftStereoConfig, variables,
                  iters: int = 32, divis_by: int = 32,
                  shape_bucket: Optional[int] = None,
-                 max_cached_shapes: int = 16):
+                 max_cached_shapes: int = 16,
+                 corr_fp32_auto: bool = True):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
         the reference's exact padding (off by default; the parity tests
         require /32 semantics).  ``max_cached_shapes`` bounds the per-shape
         executable cache LRU-style so a many-shape eval (Middlebury-F) holds
-        memory flat instead of accumulating compiled programs forever."""
+        memory flat instead of accumulating compiled programs forever.
+        ``corr_fp32_auto`` guards deep-iteration bf16 correlation: at
+        ``iters >= DEEP_ITERS_FP32_CORR`` a mixed-precision config without
+        ``corr_fp32`` gets it enabled here (with a one-line warning) —
+        the measured 32-iter drift on trained weights is the reason
+        (BF16_DRIFT_r03.json).  Pass False to measure raw bf16 numerics
+        (tools/bf16_drift.py does)."""
         if shape_bucket is not None and shape_bucket % divis_by:
             raise ValueError(f"shape_bucket={shape_bucket} must be a "
                              f"multiple of the model's /{divis_by} "
@@ -45,12 +63,25 @@ class InferenceRunner:
         if max_cached_shapes < 1:
             raise ValueError(
                 f"max_cached_shapes={max_cached_shapes} must be >= 1")
+        # ``self.config`` stays the config AS GIVEN — consumers compare it
+        # against their own (eval.validate.make_validation_fn re-creates the
+        # runner on mismatch); the guard's flip lives in effective_config.
         self.config = config
+        self.effective_config = config
+        if (corr_fp32_auto and iters >= DEEP_ITERS_FP32_CORR
+                and config.mixed_precision and not config.corr_fp32):
+            self.effective_config = dataclasses.replace(config,
+                                                        corr_fp32=True)
+            log.warning(
+                "iters=%d >= %d with bf16 correlation: enabling corr_fp32 "
+                "for this runner (measured 32-iter drift on trained "
+                "weights, BF16_DRIFT_r03.json; pass corr_fp32_auto=False "
+                "to keep bf16 corr)", iters, DEEP_ITERS_FP32_CORR)
         self.variables = variables
         self.iters = iters
         self.divis_by = shape_bucket or divis_by
         self.max_cached_shapes = max_cached_shapes
-        self.model = RAFTStereo(config)
+        self.model = RAFTStereo(self.effective_config)
         self._compiled: Dict[Tuple[int, int], any] = {}
 
     def _forward_for(self, padded_hw: Tuple[int, int]):

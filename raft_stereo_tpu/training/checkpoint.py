@@ -124,13 +124,25 @@ def load_checkpoint(path: str, target: Optional[Any] = None
         target = jax.device_get(target)
         try:
             restored = ckptr.restore(state_path, target=target)
-        except Exception:
-            # Structure mismatch: retry against the pre-round-3 split-gate
-            # layout and merge back (no-op split -> nothing legacy to match
-            # -> the original error class re-raises from this restore).
+        except Exception as err:
+            # Retry against the pre-round-3 split-gate layout ONLY when the
+            # split actually changes the tree (the target contains fused
+            # convzr nodes) — failures unrelated to the gate migration
+            # (corrupt file, I/O error, other structure drift) propagate
+            # untouched instead of surfacing as a legacy-layout mismatch.
             legacy = _split_convzr(target)
-            restored = _merge_convzr(
-                ckptr.restore(state_path, target=legacy))
+            same = (jax.tree_util.tree_structure(legacy)
+                    == jax.tree_util.tree_structure(target))
+            if same:
+                raise
+            try:
+                restored = _merge_convzr(
+                    ckptr.restore(state_path, target=legacy))
+            except Exception as legacy_err:
+                log.error("restore of %s failed against both the current "
+                          "and the legacy convz/convr layouts; the "
+                          "current-layout error follows as __cause__", path)
+                raise legacy_err from err
             log.info("migrated legacy convz/convr checkpoint %s to the "
                      "fused convzr layout", path)
     else:

@@ -86,18 +86,34 @@ class _DevicePrefetcher:
             raise item
         return item
 
-    def close(self):
+    def close(self, timeout: float = 5.0):
         self._stop.set()
         # unblock a producer waiting on a full queue, then wait for it to
         # leave the JAX runtime — a daemon thread still inside device_put at
-        # interpreter teardown crashes the process exit.
-        while self._thread.is_alive():
+        # interpreter teardown crashes the process exit.  Bounded: if the
+        # producer wedges inside device_put/shard_batch (plausible behind a
+        # remote device tunnel) we abandon the daemon thread with a warning
+        # instead of spinning train()'s finally block forever.
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
             while not self._q.empty():
                 try:
                     self._q.get_nowait()
                 except Exception:  # pragma: no cover - raced drain
                     break
             self._thread.join(timeout=0.2)
+        if self._thread.is_alive():  # pragma: no cover - wedged upload
+            # Abandon the daemon thread so train()'s finally block cannot
+            # spin forever — but give it one last bounded join at interpreter
+            # exit: a daemon thread killed MID-device_put at teardown can
+            # crash process exit (the hazard the loop above normally
+            # retires), and the atexit grace period lets a late-flushing
+            # tunnel upload complete before teardown begins.
+            log.warning("device prefetch thread still alive after %.1fs; "
+                        "abandoning it (final %.1fs join registered at "
+                        "interpreter exit)", timeout, timeout)
+            import atexit
+            atexit.register(self._thread.join, timeout)
 
 
 def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,

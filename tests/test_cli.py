@@ -157,3 +157,32 @@ def test_runner_cache_bounded_and_bucketed(tiny_checkpoint):
         flow, _ = bucketed(img, img)
         assert flow.shape == (h, w)  # exact unpad regardless of bucket
     assert len(bucketed._compiled) == 1  # all bucket to (64, 128)
+
+
+@pytest.mark.quick  # overrides the module slow mark: runner-construction only
+def test_runner_deep_iters_bf16_corr_guard():
+    """iters >= DEEP_ITERS_FP32_CORR with bf16 corr flips corr_fp32 in the
+    runner's effective config (measured 32-iter drift, BF16_DRIFT_r03.json);
+    the as-given config is preserved for identity comparisons, and
+    corr_fp32_auto=False opts out (tools/bf16_drift.py measures raw bf16)."""
+    import dataclasses
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.eval.runner import DEEP_ITERS_FP32_CORR, InferenceRunner
+
+    cfg = RaftStereoConfig(mixed_precision=True)
+    assert not cfg.corr_fp32
+    deep = InferenceRunner(cfg, {}, iters=DEEP_ITERS_FP32_CORR)
+    assert deep.effective_config.corr_fp32
+    assert deep.config == cfg  # make_validation_fn compares this
+    assert deep.effective_config == dataclasses.replace(cfg, corr_fp32=True)
+
+    shallow = InferenceRunner(cfg, {}, iters=7)
+    assert not shallow.effective_config.corr_fp32
+
+    opted_out = InferenceRunner(cfg, {}, iters=32, corr_fp32_auto=False)
+    assert not opted_out.effective_config.corr_fp32
+
+    fp32_cfg = RaftStereoConfig()  # no mixed precision -> nothing to guard
+    assert not InferenceRunner(fp32_cfg, {},
+                               iters=32).effective_config.corr_fp32

@@ -1,4 +1,5 @@
 """Data layer: format readers, augmentors, datasets, loader."""
+import os
 
 import numpy as np
 import pytest
@@ -230,8 +231,11 @@ def test_sceneflow_loader_decode_throughput(tmp_path):
     assert b["flow"].shape == (4, 96, 160)
     assert np.all(b["flow"] <= 0)  # x-flow = -disparity
     assert set(np.unique(b["valid"])) <= {0.0, 1.0}
-    # 16 images decoded+augmented; a deliberately loose floor (locally
-    # ~10x above it) so only order-of-magnitude decode-path regressions
-    # fail, not a contended CI runner.  Real throughput-vs-demand evidence
-    # is bench_loader.py's job on the bench host.
-    assert 16 / dt > 2.0, f"decode path too slow: {16 / dt:.1f} images/s"
+    # 16 images decoded+augmented; wall-clock floors flake on oversubscribed
+    # CI runners no matter the headroom, so the timing assert is opt-in
+    # (RAFT_TPU_TIMING_ASSERTS=1 on a quiet host).  Real throughput-vs-demand
+    # evidence is bench_loader.py's job on the bench host; the shape/dtype
+    # contract asserts above stay unconditional.
+    if os.environ.get("RAFT_TPU_TIMING_ASSERTS", "").lower() in (
+            "1", "true", "yes"):
+        assert 16 / dt > 2.0, f"decode path too slow: {16 / dt:.1f} images/s"

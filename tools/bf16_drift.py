@@ -136,7 +136,10 @@ def evaluate(tag, cfg_variables, scenes):
 
     rows = []
     for iters in ITERS:
-        runners = {name: InferenceRunner(cfg, variables, iters=iters)
+        # corr_fp32_auto off: this tool MEASURES raw bf16-corr drift at deep
+        # iteration counts — the very thing the runner's guard would mask.
+        runners = {name: InferenceRunner(cfg, variables, iters=iters,
+                                         corr_fp32_auto=False)
                    for name, (cfg, variables) in cfg_variables.items()}
         for band, rows_in in scenes.items():
             preds = {name: [] for name in runners}
