@@ -63,26 +63,75 @@ def build_tree(root: str, n_pairs: int, seed: int = 0, hw=(H, W)) -> None:
         write_pfm(os.path.join(dseq, "0006.pfm"), disp)
 
 
-def make_loader(root: str, workers: int):
+def make_loader(root: str, workers: int, photometric: bool = True,
+                worker_type: str = "thread"):
     from raft_stereo_tpu.data.datasets import SceneFlow
     from raft_stereo_tpu.data.loader import StereoLoader
 
     aug = {"crop_size": CROP, "min_scale": -0.2, "max_scale": 0.4,
-           "do_flip": None, "yjitter": True}
+           "do_flip": None, "yjitter": True, "photometric": photometric}
     ds = SceneFlow(aug, root=root, dstype="frames_cleanpass")
     return StereoLoader(ds, batch_size=BATCH, num_workers=workers,
-                        prefetch=2, seed=0)
+                        prefetch=2, seed=0, worker_type=worker_type)
 
 
-def measure_host(root: str, workers: int, n_batches: int) -> float:
-    loader = make_loader(root, workers)
+def measure_host(root: str, workers: int, n_batches: int,
+                 photometric: bool = True,
+                 worker_type: str = "thread") -> float:
+    loader = make_loader(root, workers, photometric, worker_type)
     it = iter(loader)
     next(it)  # warm: thread spin-up, file-cache population
     t0 = time.perf_counter()
     for _ in range(n_batches):
         next(it)
     dt = time.perf_counter() - t0
+    del it
     return n_batches * BATCH / dt
+
+
+def stage_breakdown(root: str) -> dict:
+    """Per-stage host ms for one sample (decode, photometric, spatial) —
+    the evidence for what device_photometric moves off the host."""
+    import glob as _glob
+
+    from raft_stereo_tpu.data import frame_utils
+    from raft_stereo_tpu.data.augment import DenseAugmentor, _eraser
+
+    candidates = []
+    for dstype in ("frames_cleanpass", "frames_finalpass"):
+        candidates += sorted(_glob.glob(os.path.join(
+            root, "FlyingThings3D", dstype, "TRAIN/*/*/left/*.png")))[:1]
+    if not candidates:  # e.g. a Monkaa/Driving-only root: skip, don't crash
+        return {"skipped": "no FlyingThings TRAIN pair under this root"}
+    left_p = candidates[0]
+    right_p = left_p.replace("left", "right")
+    dstype = left_p.split(os.sep + "FlyingThings3D" + os.sep)[1].split(
+        os.sep)[0]
+    disp_p = left_p.replace(dstype, "disparity").replace(".png", ".pfm")
+    aug = DenseAugmentor(CROP, -0.2, 0.4, None, True)
+    rngf = lambda: np.random.default_rng(0)  # noqa: E731
+
+    def t(f, n=15):
+        f()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    img1 = frame_utils.read_image(left_p)
+    img2 = frame_utils.read_image(right_p)
+    disp = frame_utils.read_gen(disp_p)
+    flow = np.stack([-disp, np.zeros_like(disp)], axis=-1)
+    decode_ms = t(lambda: (frame_utils.read_image(left_p),
+                           frame_utils.read_image(right_p),
+                           frame_utils.read_gen(disp_p)))
+    color_ms = t(lambda: aug._color(img1, img2, rngf()))
+    c1, c2 = aug._color(img1, img2, rngf())
+    e2 = _eraser(c2, rngf())
+    spatial_ms = t(lambda: aug._spatial(c1, e2, flow, rngf()))
+    return {"decode_ms": round(decode_ms, 1),
+            "photometric_ms": round(color_ms, 1),
+            "spatial_ms": round(spatial_ms, 1)}
 
 
 def main():
@@ -103,12 +152,20 @@ def main():
     if not args.root:
         build_tree(root, args.pairs)
 
+    print(json.dumps({"metric": "loader_stage_breakdown_ms",
+                      **stage_breakdown(root), "unit": "ms/sample"}))
+
     for w in args.workers:
-        ips = measure_host(root, w, args.batches)
-        print(json.dumps({
-            "metric": "loader_images_per_s", "workers": w,
-            "native_decoders": native.available(),
-            "value": round(ips, 2), "unit": f"images/s (540x960 -> {CROP})"}))
+        for wt in (("thread",) if w == 0 else ("thread", "process")):
+            for photometric in (True, False):
+                ips = measure_host(root, w, args.batches,
+                                   photometric=photometric, worker_type=wt)
+                print(json.dumps({
+                    "metric": "loader_images_per_s", "workers": w,
+                    "worker_type": wt, "host_photometric": photometric,
+                    "native_decoders": native.available(),
+                    "value": round(ips, 2),
+                    "unit": f"images/s (540x960 -> {CROP})"}))
 
     if args.device:
         import functools
@@ -123,6 +180,8 @@ def main():
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
 
+        from raft_stereo_tpu.data.device_jitter import params_for_datasets
+
         model_cfg = RaftStereoConfig(mixed_precision=True)
         train_cfg = TrainConfig(batch_size=BATCH, train_iters=22,
                                 image_size=CROP)
@@ -132,14 +191,19 @@ def main():
         step = jax.jit(functools.partial(
             train_step, iters=22, loss_gamma=train_cfg.loss_gamma,
             max_flow=train_cfg.max_flow), donate_argnums=(0,))
+        step_devjit = jax.jit(functools.partial(
+            train_step, iters=22, loss_gamma=train_cfg.loss_gamma,
+            max_flow=train_cfg.max_flow,
+            jitter=params_for_datasets(("sceneflow",))), donate_argnums=(0,))
 
         from raft_stereo_tpu.training.train_loop import _DevicePrefetcher
 
-        def run(batch_iter, n, prefetch: bool):
+        def run(batch_iter, n, prefetch: bool, step_fn=None):
             """``prefetch`` runs the host->device upload on the train
             loop's own _DevicePrefetcher thread (the product path);
             without it the upload is serial with dispatch."""
             nonlocal state
+            step_fn = step_fn or step
             metrics = None
             it = (_DevicePrefetcher(batch_iter, jax.device_put)
                   if prefetch else
@@ -147,7 +211,7 @@ def main():
                    for b in batch_iter))
             t0 = time.perf_counter()
             for _ in range(n):
-                state, metrics = step(state, next(it))
+                state, metrics = step_fn(state, next(it))
             # device_get is a REAL transfer (block_until_ready returns at
             # dispatch behind this env's async tunnel — bench.py), so the
             # stop clock includes every dispatched step.
@@ -176,6 +240,26 @@ def main():
             "synthetic_batch_s": round(synth_s, 4),
             "synthetic_batch_prefetch_s": round(synth_pf_s, 4),
             "host_overhead_pct": round(100 * (real_s / synth_pf_s - 1), 1)}))
+
+        # Same combined run with photometric moved on-device: host loader
+        # skips ColorJitter (78% of its per-sample CPU), the train step
+        # applies the jitter inside the compiled program.
+        dj_loader = make_loader(root, workers=max(args.workers),
+                                photometric=False)
+        dj_it = iter(dj_loader)
+        first_dj = next(dj_it)
+        run(iter([first_dj]), 1, prefetch=False,
+            step_fn=step_devjit)  # compile the devjit variant
+        devjit_s = run(dj_it, args.batches, prefetch=True,
+                       step_fn=step_devjit)
+        print(json.dumps({
+            "metric": "combined_loader_train_step_device_photometric",
+            "value": round(devjit_s, 4),
+            "unit": "s/step (real loader, jitter on device)",
+            "vs_host_jitter": round(devjit_s / real_s, 3),
+            "synthetic_batch_prefetch_s": round(synth_pf_s, 4),
+            "host_overhead_pct":
+                round(100 * (devjit_s / synth_pf_s - 1), 1)}))
 
 
 if __name__ == "__main__":

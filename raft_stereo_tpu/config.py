@@ -213,6 +213,14 @@ class TrainConfig:
     do_flip: Optional[str] = None  # None | "h" | "v"
     spatial_scale: Tuple[float, float] = (-0.2, 0.4)
     noyjitter: bool = False
+    # Move photometric jitter (ColorJitter + gamma) from the host loader
+    # into the jitted train step (data/device_jitter.py).  On a host with
+    # few cores the jitter dominates the per-sample CPU budget (~63 of
+    # 80 ms/sample measured at SceneFlow frames) while the chip absorbs the
+    # same elementwise work in milliseconds.  Distribution-equivalent, not
+    # bit-equal, to host jitter (it runs after the crop and skips uint8
+    # rounding between ops); the host path stays the default.
+    device_photometric: bool = False
     # Runtime
     validation_frequency: int = 10_000
     seed: int = 1234

@@ -163,7 +163,8 @@ class DenseAugmentor:
 
     def __init__(self, crop_size: Tuple[int, int], min_scale=-0.2,
                  max_scale=0.5, do_flip: Optional[str] = None, yjitter=False,
-                 saturation_range=(0.6, 1.4), gamma=(1, 1, 1, 1)):
+                 saturation_range=(0.6, 1.4), gamma=(1, 1, 1, 1),
+                 photometric=True):
         self.crop_size = tuple(crop_size)
         self.min_scale = min_scale
         self.max_scale = max_scale
@@ -172,6 +173,9 @@ class DenseAugmentor:
         self.stretch_prob = 0.8
         self.max_stretch = 0.2
         self.asymmetric_prob = 0.2
+        # photometric=False: jitter runs on-device inside the train step
+        # instead (data/device_jitter.py; TrainConfig.device_photometric)
+        self.photometric = photometric
         self.jitter = ColorJitter(0.4, 0.4, saturation_range, 0.5 / 3.14,
                                   gamma)
 
@@ -222,7 +226,8 @@ class DenseAugmentor:
     def __call__(self, img1: np.ndarray, img2: np.ndarray, flow: np.ndarray,
                  rng: np.random.Generator):
         """uint8 (H,W,3) ×2 + float32 (H,W,2) flow → cropped/augmented."""
-        img1, img2 = self._color(img1, img2, rng)
+        if self.photometric:
+            img1, img2 = self._color(img1, img2, rng)
         img2 = _eraser(img2, rng)
         img1, img2, flow = self._spatial(img1, img2, flow, rng)
         return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
@@ -237,13 +242,15 @@ class SparseAugmentor:
 
     def __init__(self, crop_size: Tuple[int, int], min_scale=-0.2,
                  max_scale=0.5, do_flip: Optional[str] = None, yjitter=False,
-                 saturation_range=(0.7, 1.3), gamma=(1, 1, 1, 1)):
+                 saturation_range=(0.7, 1.3), gamma=(1, 1, 1, 1),
+                 photometric=True):
         self.crop_size = tuple(crop_size)
         self.min_scale = min_scale
         self.max_scale = max_scale
         self.do_flip = do_flip
         # yjitter accepted-but-unused, like the reference (:184 signature)
         self.spatial_aug_prob = 0.8
+        self.photometric = photometric
         self.jitter = ColorJitter(0.3, 0.3, saturation_range, 0.3 / 3.14,
                                   gamma)
 
@@ -297,9 +304,10 @@ class SparseAugmentor:
         return img1, img2, flow, valid
 
     def __call__(self, img1, img2, flow, valid, rng: np.random.Generator):
-        stack = np.concatenate([img1, img2], axis=0)
-        stack = self.jitter(stack, rng)
-        img1, img2 = np.split(stack, 2, axis=0)
+        if self.photometric:
+            stack = np.concatenate([img1, img2], axis=0)
+            stack = self.jitter(stack, rng)
+            img1, img2 = np.split(stack, 2, axis=0)
         img2 = _eraser(img2, rng)
         img1, img2, flow, valid = self._spatial(img1, img2, flow, valid, rng)
         return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),

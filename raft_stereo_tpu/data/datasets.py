@@ -357,6 +357,9 @@ def build_training_mixture(train_cfg, data_root: str = "datasets"
         "max_scale": train_cfg.spatial_scale[1],
         "do_flip": train_cfg.do_flip,
         "yjitter": not train_cfg.noyjitter,
+        # device_photometric moves ColorJitter into the jitted train step
+        # (data/device_jitter.py); the host augmentor then skips it
+        "photometric": not train_cfg.device_photometric,
     }
     if train_cfg.saturation_range is not None:
         aug_params["saturation_range"] = tuple(train_cfg.saturation_range)

@@ -13,6 +13,7 @@ a (seed, step) pair maps to one exact batch regardless of thread scheduling.
 
 from __future__ import annotations
 
+import pickle
 import queue
 import threading
 from typing import Dict, Iterator, Optional
@@ -20,6 +21,29 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 from raft_stereo_tpu.data.datasets import StereoDataset
+
+def _collate(dataset: StereoDataset, epoch: int, indices
+             ) -> Dict[str, np.ndarray]:
+    """THE batch-assembly contract — every worker flavor (sync, thread,
+    process) builds batches through this one function."""
+    samples = [dataset.__getitem__(int(i), epoch) for i in indices]
+    return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+# --------------------------------------------------- process-worker plumbing
+# Module-level so child processes (spawn) can import it; the dataset is
+# shipped once via the pool initializer, not per task.
+_WORKER_DATASET: Optional[StereoDataset] = None
+
+
+def _process_worker_init(ds_bytes: bytes) -> None:
+    global _WORKER_DATASET
+    _WORKER_DATASET = pickle.loads(ds_bytes)
+
+
+def _process_make_batch(args):
+    epoch, indices = args
+    return _collate(_WORKER_DATASET, epoch, indices)
 
 
 class StereoLoader:
@@ -43,7 +67,8 @@ class StereoLoader:
                  shuffle: bool = True, num_workers: int = 4,
                  prefetch: int = 2, seed: int = 1234,
                  epochs: Optional[int] = None,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 worker_type: str = "thread"):
         if len(dataset) < batch_size:
             raise ValueError(
                 f"dataset has {len(dataset)} samples < batch_size={batch_size}")
@@ -53,6 +78,9 @@ class StereoLoader:
         if not (0 <= process_index < process_count):
             raise ValueError(f"process_index={process_index} out of range "
                              f"for process_count={process_count}")
+        if worker_type not in ("thread", "process"):
+            raise ValueError(f"worker_type={worker_type!r} not in "
+                             f"('thread', 'process')")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -62,6 +90,18 @@ class StereoLoader:
         self.epochs = epochs
         self.process_index = process_index
         self.process_count = process_count
+        # "process": decode+augment in spawned worker PROCESSES — sidesteps
+        # the GIL entirely where thread workers only overlap the
+        # GIL-releasing segments (native decode, cv2).  Costs one extra
+        # batch copy (pickle over the pipe) per batch, so it pays off on
+        # multi-core hosts where augment's pure-NumPy Python dominates.
+        # Determinism is identical: a batch is a pure function of
+        # (seed, epoch, indices) regardless of which worker builds it.
+        # NOTE: like any spawn-based pool (torch DataLoader included), the
+        # launching script must be import-safe — iteration from a script
+        # without an ``if __name__ == "__main__"`` guard re-executes that
+        # script in every worker.
+        self.worker_type = worker_type
 
     def __len__(self) -> int:
         return len(self.dataset) // self.batch_size  # drop_last
@@ -74,12 +114,13 @@ class StereoLoader:
 
     def _make_batch(self, epoch: int, indices: np.ndarray
                     ) -> Dict[str, np.ndarray]:
-        samples = [self.dataset.__getitem__(int(i), epoch) for i in indices]
-        return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+        return _collate(self.dataset, epoch, indices)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if self.num_workers <= 0:
             yield from self._iter_sync()
+        elif self.worker_type == "process":
+            yield from self._iter_process()
         else:
             yield from self._iter_threaded()
 
@@ -98,6 +139,38 @@ class StereoLoader:
     def _iter_sync(self):
         for epoch, idx in self._batch_indices():
             yield self._make_batch(epoch, idx)
+
+    def _iter_process(self):
+        """Spawned worker processes; submission order = yield order (an
+        ordered deque of futures doubles as the reorder buffer), with at
+        most ``prefetch + num_workers`` batches in flight."""
+        import collections
+        import concurrent.futures as cf
+        import multiprocessing as mp
+
+        # spawn, not fork: the parent holds a live JAX runtime whose
+        # internal threads/locks must not be duplicated into children
+        ctx = mp.get_context("spawn")
+        ds_bytes = pickle.dumps(self.dataset)
+        max_ahead = self.prefetch + self.num_workers
+        with cf.ProcessPoolExecutor(self.num_workers, mp_context=ctx,
+                                    initializer=_process_worker_init,
+                                    initargs=(ds_bytes,)) as pool:
+            gen = self._batch_indices()
+            inflight: "collections.deque" = collections.deque()
+            exhausted = False
+            while True:
+                while not exhausted and len(inflight) < max_ahead:
+                    try:
+                        epoch, idx = next(gen)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    inflight.append(pool.submit(_process_make_batch,
+                                                (epoch, idx)))
+                if not inflight:
+                    return
+                yield inflight.popleft().result()
 
     def _iter_threaded(self):
         """Workers claim batch slots from a ticket queue and publish into a

@@ -18,13 +18,18 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_stereo_tpu.config import TrainConfig
+from raft_stereo_tpu.data.device_jitter import (JitterParams,
+                                                apply_photometric,
+                                                params_for_datasets)
 from raft_stereo_tpu.parallel.mesh import DATA_AXIS
 from raft_stereo_tpu.training.loss import sequence_loss
 from raft_stereo_tpu.training.state import TrainState
 
 
 def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
-               *, iters: int, loss_gamma: float, max_flow: float
+               *, iters: int, loss_gamma: float, max_flow: float,
+               jitter: Optional[JitterParams] = None,
+               jitter_seed: int = 0
                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimization step.
 
@@ -32,10 +37,20 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
     ships uint8 to quarter the host->device transfer; the model normalizes
     either on device), flow (B,H,W) x-flow (= -disparity), valid (B,H,W)
     in {0,1}.
+    ``jitter``: on-device photometric augmentation params
+    (TrainConfig.device_photometric); the PRNG key is folded from
+    ``(jitter_seed, state.step)`` so the factor stream is deterministic
+    per step and bit-identical across an exact resume.
     """
 
     # Tolerate states built without create_train_state (batch_stats=None).
     batch_stats = state.batch_stats if state.batch_stats is not None else {}
+
+    if jitter is not None:
+        key = jax.random.fold_in(jax.random.PRNGKey(jitter_seed), state.step)
+        img1, img2 = apply_photometric(batch["image1"], batch["image2"],
+                                       key, jitter)
+        batch = dict(batch, image1=img1, image2=img2)
 
     def loss_fn(params):
         preds = state.apply_fn(
@@ -58,9 +73,15 @@ def make_train_step(train_cfg: TrainConfig, mesh: Optional[Mesh] = None,
     ``data`` and the state replicated; XLA derives the gradient all-reduce
     (psum over ICI) from the shardings — the SPMD replacement for
     ``nn.DataParallel`` (reference: train_stereo.py:134)."""
+    jitter = None
+    if train_cfg.device_photometric:
+        jitter = params_for_datasets(train_cfg.train_datasets,
+                                     saturation_range=train_cfg.saturation_range,
+                                     img_gamma=train_cfg.img_gamma)
     step = functools.partial(train_step, iters=train_cfg.train_iters,
                              loss_gamma=train_cfg.loss_gamma,
-                             max_flow=train_cfg.max_flow)
+                             max_flow=train_cfg.max_flow,
+                             jitter=jitter, jitter_seed=train_cfg.seed)
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
