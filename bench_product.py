@@ -62,6 +62,22 @@ def main():
         runner = InferenceRunner(cfg, variables, iters=ITERS)
         res = validate_kitti(runner, root=root)
 
+        # --- batched product mode: upload BATCH pairs per round trip.
+        # Amortizes the tunnel RTT + per-image transfer setup the per-image
+        # protocol pays 1x per frame (PRODUCT_r03.json decomposition); any
+        # real remote deployment would batch the same way.
+        from raft_stereo_tpu.data.frame_utils import read_image
+        BATCHED_N = 8
+        lefts = [read_image(os.path.join(root, "training", "image_2",
+                                         f"{i:06d}_10.png"))
+                 for i in range(BATCHED_N)]
+        rights = [read_image(os.path.join(root, "training", "image_3",
+                                          f"{i:06d}_10.png"))
+                  for i in range(BATCHED_N)]
+        runner.run_batch(lefts, rights)  # compile + warm
+        batched = [runner.run_batch(lefts, rights)[1] for _ in range(5)]
+        batched_s = float(np.median(batched)) / BATCHED_N
+
     # --- bare forward at the same padded shape (bench.py's method)
     h = -(-KITTI_HW[0] // 32) * 32
     w = -(-KITTI_HW[1] // 32) * 32
@@ -101,10 +117,12 @@ def main():
 
     fps_product = res["kitti-fps"]
     fps_bare = 1.0 / bare_s
-    print(json.dumps({
+    rec = {
         "metric": "product_path_fps_kitti",
         "value": round(fps_product, 2),
         "unit": "frames/s (validate_kitti end-to-end, 375x1242)",
+        "batched_fps": round(1.0 / batched_s, 2),
+        "batched_n_per_roundtrip": BATCHED_N,
         "bare_forward_fps": round(fps_bare, 2),
         "gap": round(fps_product / fps_bare, 3),
         "per_image_overhead_ms": round(1e3 * (1 / fps_product - bare_s), 2),
@@ -113,7 +131,10 @@ def main():
         "tunnel_fetch_flow_ms": round(down_ms, 1),
         "kitti_epe_random_weights": round(res["kitti-epe"], 2),
         "n_timed": N_IMAGES - 50,  # FpsProtocol times images 51..N
-    }))
+    }
+    print(json.dumps(rec))
+    with open(os.path.join(_REPO, "PRODUCT_r04.json"), "w") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 if __name__ == "__main__":

@@ -72,6 +72,8 @@ def main():
                     help="capture a profiler trace into this directory")
     ap.add_argument("--corr_backend", default=None,
                     help="override the default correlation backend")
+    ap.add_argument("--remat_save", nargs="*", default=None,
+                    help="remat policy save names (config.remat_save)")
     args = ap.parse_args()
 
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
@@ -88,6 +90,8 @@ def main():
     model_kw = {"mixed_precision": True}
     if args.corr_backend:
         model_kw["corr_backend"] = args.corr_backend
+    if args.remat_save is not None:
+        model_kw["remat_save"] = tuple(args.remat_save)
     model_cfg = RaftStereoConfig(**model_kw)
     train_cfg = TrainConfig(batch_size=BATCH, train_iters=ITERS,
                             image_size=(H, W))

@@ -76,6 +76,16 @@ class RaftStereoConfig:
     # ~10x less activation memory).  Turn off when per-device batch is small
     # enough (e.g. data-parallel over many chips) to trade memory for speed.
     remat_gru: bool = True
+    # Named intermediates the remat policy SAVES instead of recomputing in
+    # the backward pass (jax save_only_these_names).  Available names:
+    # "corr_lookup" (the Pallas lookup output, ~2 MB/iter — saves a kernel
+    # launch per backward iteration, measured -7.4% step time, round 3),
+    # "gru_gates" (pre-activation convzr/convq outputs of every ConvGRU
+    # level, ~110 MB/iter at the SceneFlow config), "motion_features"
+    # (BasicMotionEncoder output, ~30 MB/iter).  Each trades HBM for
+    # skipped recompute; see docs/TRAIN_PROFILE.md round 4 for chip
+    # measurements of the combinations.
+    remat_save: Tuple[str, ...] = ("corr_lookup",)
     # Stream the encoders' FULL-RESOLUTION stages in horizontal bands
     # (models/banded.py): only band-sized activations exist, cutting peak
     # HBM several-fold at Middlebury-F-class resolutions in exchange for
@@ -89,13 +99,15 @@ class RaftStereoConfig:
     # Extension beyond the reference: shard the IMAGE-ROW axis of the
     # encoders' full-resolution segment across a mesh axis (context
     # parallelism — parallel/rows_sharded.py): each device holds 1/N of the
-    # full-res stem activations.  INFERENCE/EVAL scope: trace the forward
-    # under ``parallel.rows_sharded.rows_sharding(mesh)``; the train loop
-    # does NOT auto-wire it (its data axis carries the batch — rows
-    # sharding there would need a dedicated mesh axis and is untested for
-    # training).  Supported for the same trunks as banded_encoder
-    # (n_downsample=2, instance/batch/none norms); incompatible with
-    # banded_encoder (pick streaming OR sharding for the segment).
+    # full-res stem activations.  Training: the train loop auto-wires a
+    # dedicated ``rows`` mesh axis composing with data/corr (gradients flow
+    # through the ppermute halos and gathered instance-norm moments —
+    # tests/test_rows_sharded.py training-parity test); image height must
+    # be divisible by 4*rows_shards.  Inference/eval: trace the forward
+    # under ``parallel.rows_sharded.rows_sharding(mesh)``.  Supported for
+    # the same trunks as banded_encoder (n_downsample=2,
+    # instance/batch/none norms); incompatible with banded_encoder (pick
+    # streaming OR sharding for the segment).
     rows_shards: int = 1
     # Pixel count above which fnet processes the two images sequentially
     # instead of as one batch-2 concat (halves the full-resolution stem's
@@ -133,6 +145,12 @@ class RaftStereoConfig:
             raise ValueError(
                 "rows_shards and banded_encoder both replace the "
                 "full-resolution segment's executor — enable at most one")
+        object.__setattr__(self, "remat_save", tuple(self.remat_save))
+        known_saves = {"corr_lookup", "gru_gates", "motion_features"}
+        unknown = set(self.remat_save) - known_saves
+        if unknown:
+            raise ValueError(f"remat_save names {sorted(unknown)} unknown; "
+                             f"choose from {sorted(known_saves)}")
         if self.corr_w2_shards > 1 and self.corr_backend == "alt":
             raise ValueError(
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "

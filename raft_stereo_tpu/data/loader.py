@@ -153,9 +153,10 @@ class StereoLoader:
         ctx = mp.get_context("spawn")
         ds_bytes = pickle.dumps(self.dataset)
         max_ahead = self.prefetch + self.num_workers
-        with cf.ProcessPoolExecutor(self.num_workers, mp_context=ctx,
-                                    initializer=_process_worker_init,
-                                    initargs=(ds_bytes,)) as pool:
+        pool = cf.ProcessPoolExecutor(self.num_workers, mp_context=ctx,
+                                      initializer=_process_worker_init,
+                                      initargs=(ds_bytes,))
+        try:
             gen = self._batch_indices()
             inflight: "collections.deque" = collections.deque()
             exhausted = False
@@ -171,6 +172,13 @@ class StereoLoader:
                 if not inflight:
                     return
                 yield inflight.popleft().result()
+        finally:
+            # Early close (consumer break / GeneratorExit) must not sit
+            # through prefetch+num_workers queued full-frame batches — drop
+            # the queue and leave only the in-flight task per worker to
+            # drain in the background (e.g. a SIGTERM-triggered checkpoint
+            # would otherwise stall multiple seconds here).
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def _iter_threaded(self):
         """Workers claim batch slots from a ticket queue and publish into a

@@ -32,6 +32,17 @@ log = logging.getLogger(__name__)
 WARMUP_IMAGES = 50
 
 
+def single_device_cfg(cfg):
+    """Strip multi-device executor flags for the periodic validator: it is
+    single-device inference, the sharded executors are numerically
+    equivalent (their parity tests), and they would demand an active mesh
+    context inside the hook."""
+    if cfg.rows_shards > 1 or cfg.corr_w2_shards > 1:
+        import dataclasses
+        return dataclasses.replace(cfg, rows_shards=1, corr_w2_shards=1)
+    return cfg
+
+
 def _validate(runner: InferenceRunner, dataset, name: str,
               bad_threshold: float,
               valid_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
@@ -107,7 +118,8 @@ def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
         # model_cfg=None -> the config captured at construction; train()
         # passes the authoritative one (a --restore_ckpt re-derives the
         # architecture, so the CLI-time config can be stale).
-        cfg = captured_cfg if model_cfg is None else model_cfg
+        cfg = single_device_cfg(captured_cfg if model_cfg is None
+                                else model_cfg)
         nonlocal runner
         if runner is None or runner.config != cfg:
             runner = InferenceRunner(cfg, variables,

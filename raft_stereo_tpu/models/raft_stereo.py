@@ -283,16 +283,17 @@ class RAFTStereo(nn.Module):
         if cfg.remat_gru:
             # Backward recomputes each iteration from its carry instead of
             # storing every update-block activation (see config.remat_gru).
-            # Exception: the correlation lookup output is SAVED (named above)
-            # — it is small (K·levels channels at 1/2^n resolution, ~2 MB/iter
-            # at the SceneFlow config) while its recompute is a full Pallas
-            # kernel launch per iteration, the single largest remat overhead
-            # in the training trace.  prevent_cse=False is safe (and
+            # Exception: the intermediates named in cfg.remat_save are kept
+            # — by default the correlation lookup output (small at ~2
+            # MB/iter while its recompute is a full Pallas kernel launch
+            # per backward iteration, the single largest remat overhead in
+            # the round-3 trace); "gru_gates"/"motion_features" extend the
+            # trade (config.remat_save).  prevent_cse=False is safe (and
             # recommended) under scan.
             body_train = nn.remat(
                 body_train, prevent_cse=False,
                 policy=jax.checkpoint_policies.save_only_these_names(
-                    "corr_lookup"))
+                    *cfg.remat_save))
         scan_train = nn.scan(body_train, variable_broadcast=("params", "batch_stats"),
                              split_rngs={"params": False}, length=iters)
         (net_fin, disp_fin), flow_ups = scan_train(

@@ -51,16 +51,24 @@ class ConvGRU(nn.Module):
 
     @nn.compact
     def __call__(self, h, context, *x_list):
+        from jax.ad_checkpoint import checkpoint_name
+
         cz, cr, cq = context
         x = jnp.concatenate(x_list, axis=-1)
         hx = jnp.concatenate([h, x], axis=-1)
         k = self.kernel_size
-        zr = conv(2 * self.hidden_dim, k, 1, dtype=self.dtype,
-                  name="convzr")(hx)
+        # Pre-activation gate convs carry a remat name: with "gru_gates" in
+        # config.remat_save the backward reuses them instead of re-running
+        # the scan body's two largest convs (see the remat policy in
+        # models/raft_stereo.py).
+        zr = checkpoint_name(
+            conv(2 * self.hidden_dim, k, 1, dtype=self.dtype,
+                 name="convzr")(hx), "gru_gates")
         z = nn.sigmoid(zr[..., :self.hidden_dim] + cz)
         r = nn.sigmoid(zr[..., self.hidden_dim:] + cr)
-        q = nn.tanh(conv(self.hidden_dim, k, 1, dtype=self.dtype, name="convq")(
-            jnp.concatenate([r * h, x], axis=-1)) + cq)
+        q = nn.tanh(checkpoint_name(
+            conv(self.hidden_dim, k, 1, dtype=self.dtype, name="convq")(
+                jnp.concatenate([r * h, x], axis=-1)), "gru_gates") + cq)
         return (1 - z) * h + z * q
 
 
@@ -78,7 +86,11 @@ class BasicMotionEncoder(nn.Module):
         flo = nn.relu(conv(64, 3, 1, dtype=self.dtype, name="convf2")(flo))
         out = nn.relu(conv(128 - 2, 3, 1, dtype=self.dtype, name="conv")(
             jnp.concatenate([cor, flo], axis=-1)))
-        return jnp.concatenate([out, flow], axis=-1)
+        from jax.ad_checkpoint import checkpoint_name
+        # named for config.remat_save ("motion_features"): saving this
+        # output lets the backward skip the whole 5-conv encoder recompute
+        return checkpoint_name(jnp.concatenate([out, flow], axis=-1),
+                               "motion_features")
 
 
 class BasicMultiUpdateBlock(nn.Module):

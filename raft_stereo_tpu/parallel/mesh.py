@@ -34,35 +34,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 CORR_AXIS = "corr"
+# Context-parallel axis: shards the IMAGE-ROW (H) dimension of the encoders'
+# full-resolution segment (parallel/rows_sharded.py) — the stereo analog of
+# sequence parallelism, composing with data/corr on one mesh.
+ROWS_AXIS = "rows"
 
 
-def make_mesh(n_data: int = 0, n_corr: int = 1,
+def make_mesh(n_data: int = 0, n_corr: int = 1, n_rows: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(data, corr)`` mesh.
+    """Build a ``(data, corr, rows)`` mesh.
 
     Args:
       n_data: devices along the batch axis; 0 = all remaining devices.
       n_corr: devices sharding the disparity-search (W2) axis.
+      n_rows: devices sharding the image-row (H) axis of the full-res
+        encoder segment (context parallelism).
       devices: explicit device list (default ``jax.devices()``).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
     if n_data <= 0:
-        if len(devices) % n_corr:
+        if len(devices) % (n_corr * n_rows):
             raise ValueError(f"{len(devices)} devices not divisible by "
-                             f"n_corr={n_corr}")
-        n_data = len(devices) // n_corr
-    n = n_data * n_corr
+                             f"n_corr*n_rows={n_corr * n_rows}")
+        n_data = len(devices) // (n_corr * n_rows)
+    n = n_data * n_corr * n_rows
     if n > len(devices):
-        raise ValueError(f"mesh wants {n_data}×{n_corr}={n} devices but only "
-                         f"{len(devices)} are available")
+        raise ValueError(f"mesh wants {n_data}×{n_corr}×{n_rows}={n} devices "
+                         f"but only {len(devices)} are available")
     if n < len(devices):
         import warnings
         warnings.warn(f"mesh uses {n} of {len(devices)} devices; "
                       f"{len(devices) - n} will sit idle", stacklevel=2)
-    grid = np.asarray(devices[:n]).reshape(n_data, n_corr)
-    return Mesh(grid, (DATA_AXIS, CORR_AXIS))
+    grid = np.asarray(devices[:n]).reshape(n_data, n_corr, n_rows)
+    return Mesh(grid, (DATA_AXIS, CORR_AXIS, ROWS_AXIS))
 
 
 def shard_batch(batch: Any, mesh: Mesh) -> Any:

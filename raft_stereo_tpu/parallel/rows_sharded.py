@@ -101,11 +101,15 @@ def rows_sharded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
     param_specs = jax.tree_util.tree_map(lambda _: P(), (trunk_params,
                                                          batch_stats))
 
+    # Manual only over the rows axis; the batch dim stays AUTOMATIC so the
+    # outer jit's data-parallel sharding passes straight through — the same
+    # partial-manual pattern as the W2-sharded volume build
+    # (parallel/corr_sharded.py) — making this trunk usable inside the
+    # data-sharded TRAINING step, not just replicated-batch inference.
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        jax.shard_map, mesh=mesh, axis_names={axis},
         in_specs=(param_specs[0], param_specs[1], P(None, axis)),
-        out_specs=(P(None, axis), P(None, axis)),
-        check_vma=False)
+        out_specs=(P(None, axis), P(None, axis)))
     def segment_sharded(tp, bs, slab):
         idx = jax.lax.axis_index(axis)
         # Neighbor halo exchange.  ppermute zero-fills devices with no
@@ -147,6 +151,20 @@ def rows_sharded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
         return u[:, crop], v[:, crop]
 
     u, v = segment_sharded(trunk_params, batch_stats, x)
+    # Re-enter the auto-sharded world with H explicitly UNSHARDED (batch
+    # and trailing dims left to propagation).  Without this constraint XLA
+    # may keep the tail's tensors sharded over (batch x rows)
+    # simultaneously, and its SPMD conv-KERNEL-gradient partitioning then
+    # double-counts: every tail conv kernel grad came out exactly
+    # n_data x with bias/norm grads correct (reproduced on jax 0.9 CPU
+    # meshes (2,2)/(2,4); clean on (1,2) and (2,1)).  The memory win is
+    # unaffected — the full-RESOLUTION segment stays sharded; the tail is
+    # <=1/2-res.
+    from jax.sharding import NamedSharding
+    unconstr = P.UNCONSTRAINED
+    spec = NamedSharding(mesh, P(unconstr, None, unconstr, unconstr))
+    u = jax.lax.with_sharding_constraint(u, spec)
+    v = jax.lax.with_sharding_constraint(v, spec)
     # <=1/2-res tail on the reassembled tensors (instance norms here see
     # the full tensors, so no further collectives are needed by hand).
     return trunk_tail(trunk_params, batch_stats, u, v, norm_fn, dtype)

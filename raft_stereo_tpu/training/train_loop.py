@@ -141,30 +141,43 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     distributed.initialize()
     devices = jax.devices()
     n_corr = model_cfg.corr_w2_shards
-    if n_corr > 1 and not use_mesh:
-        raise ValueError("corr_w2_shards > 1 requires use_mesh=True")
-    if use_mesh and len(devices) < n_corr:
+    n_rows = model_cfg.rows_shards
+    if (n_corr > 1 or n_rows > 1) and not use_mesh:
         raise ValueError(
-            f"corr_w2_shards={n_corr} exceeds the {len(devices)} available "
-            f"devices — no device is left for the data axis")
-    n_data = train_cfg.data_parallel or len(devices) // n_corr
-    if use_mesh and n_data * n_corr > len(devices):
+            "corr_w2_shards/rows_shards > 1 requires use_mesh=True")
+    if use_mesh and len(devices) < n_corr * n_rows:
         raise ValueError(
-            f"data_parallel={n_data} x corr_w2_shards={n_corr} needs "
-            f"{n_data * n_corr} devices but only {len(devices)} are "
-            f"available")
+            f"corr_w2_shards={n_corr} x rows_shards={n_rows} exceeds the "
+            f"{len(devices)} available devices — no device is left for the "
+            f"data axis")
+    if n_rows > 1 and train_cfg.image_size[0] % (4 * n_rows):
+        raise ValueError(
+            f"rows_shards={n_rows} needs image height "
+            f"{train_cfg.image_size[0]} divisible by {4 * n_rows} "
+            f"(two stride-2 stages x row shards)")
+    n_data = train_cfg.data_parallel or len(devices) // (n_corr * n_rows)
+    if use_mesh and n_data * n_corr * n_rows > len(devices):
+        raise ValueError(
+            f"data_parallel={n_data} x corr_w2_shards={n_corr} x "
+            f"rows_shards={n_rows} needs {n_data * n_corr * n_rows} devices "
+            f"but only {len(devices)} are available")
     if train_cfg.batch_size % n_data:
         raise ValueError(f"batch_size={train_cfg.batch_size} not divisible "
                          f"by {n_data} data-parallel devices")
-    mesh = make_mesh(n_data=n_data, n_corr=n_corr,
-                     devices=devices[:n_data * n_corr]) if use_mesh else None
+    mesh = make_mesh(n_data=n_data, n_corr=n_corr, n_rows=n_rows,
+                     devices=devices[:n_data * n_corr * n_rows]
+                     ) if use_mesh else None
 
-    # W2-sharded correlation needs the mesh active whenever the model is
-    # traced (init, warm-start re-init, and the jitted step), so hold the
-    # context for the whole run.
+    # W2-sharded correlation / rows-sharded encoding need their mesh active
+    # whenever the model is traced (init, warm-start re-init, and the
+    # jitted step), so hold the contexts for the whole run.
     with contextlib.ExitStack() as ctx:
         if n_corr > 1:
             ctx.enter_context(corr_sharding(mesh))
+        if n_rows > 1:
+            from raft_stereo_tpu.parallel.mesh import ROWS_AXIS
+            from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
+            ctx.enter_context(rows_sharding(mesh, axis=ROWS_AXIS))
         return _train_impl(model_cfg, train_cfg, name, data_root,
                            checkpoint_dir, restore, log_dir, validate_fn,
                            loader, mesh)

@@ -159,6 +159,34 @@ def test_runner_cache_bounded_and_bucketed(tiny_checkpoint):
     assert len(bucketed._compiled) == 1  # all bucket to (64, 128)
 
 
+def test_runner_batched_matches_per_image(tiny_checkpoint):
+    """run_batch (one upload / one forward / one fetch for N pairs) returns
+    the same flows as N per-image calls — the throughput product mode."""
+    import numpy as np
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.training.checkpoint import load_weights
+
+    cfg, variables = load_weights(tiny_checkpoint)
+    runner = InferenceRunner(cfg, variables, iters=2)
+    rng = np.random.default_rng(3)
+    lefts = [rng.uniform(0, 255, (60, 90, 3)).astype(np.uint8)
+             for _ in range(3)]
+    rights = [np.roll(l, -3, axis=1) for l in lefts]
+
+    flows, secs = runner.run_batch(lefts, rights)
+    assert flows.shape == (3, 60, 90) and secs > 0
+    for i in range(3):
+        per_img, _ = runner(lefts[i], rights[i])
+        # batch-3 and batch-1 are different executables; XLA layout/fusion
+        # reassociation drifts a few 1e-5 on O(10) flows
+        np.testing.assert_allclose(flows[i], per_img, atol=5e-4)
+
+    with pytest.raises(AssertionError, match="same-shape"):
+        runner.run_batch([lefts[0], lefts[1][:32]],
+                         [rights[0], rights[1][:32]])
+
+
 @pytest.mark.quick  # overrides the module slow mark: runner-construction only
 def test_runner_deep_iters_bf16_corr_guard():
     """iters >= DEEP_ITERS_FP32_CORR with bf16 corr flips corr_fp32 in the

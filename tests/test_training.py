@@ -109,6 +109,35 @@ def test_train_step_single_device(rng):
     assert max(jax.tree_util.tree_leaves(diff)) > 0
 
 
+def test_remat_save_policies_bit_identical(rng):
+    """config.remat_save only changes WHAT the backward recomputes, never
+    the math: loss and the updated params are bit-identical across save
+    policies (and the unknown-name case is rejected up front)."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="remat_save"):
+        RaftStereoConfig(remat_save=("corr_lookup", "nope"))
+
+    base = RaftStereoConfig(n_gru_layers=2, hidden_dims=(32, 32),
+                            fnet_dim=64, corr_levels=2, corr_radius=3)
+    tcfg = TrainConfig(train_iters=2, num_steps=100)
+    batch = _tiny_batch(rng, b=2)
+    results = []
+    for saves in (("corr_lookup",),
+                  ("corr_lookup", "gru_gates", "motion_features")):
+        mcfg = dataclasses.replace(base, remat_save=saves)
+        state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                                   image_shape=(1, 32, 64, 3))
+        state2, metrics = make_train_step(tcfg, donate=False)(state, batch)
+        results.append((float(metrics["loss"]),
+                        jax.tree_util.tree_leaves(state2.params)))
+    assert results[0][0] == results[1][0]
+    for a, b in zip(results[0][1], results[1][1], strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.slow
 def test_train_step_sharded_matches_single(rng):
     """SPMD data-parallel step over an 8-device mesh produces the same

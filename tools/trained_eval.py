@@ -1,0 +1,437 @@
+"""Train the FULL published architecture to accuracy on-chip, survive a real
+mid-run SIGTERM, then evaluate the TRAINED checkpoint through the product
+path — the end-to-end lifecycle the reference ships
+(train -> validate every N steps -> evaluate the checkpoint;
+reference: train_stereo.py:183-193, evaluate_stereo.py:192-242).
+
+Replaces round 2/3's loss-only convergence artifact: every number here is
+produced by the REAL components — ``build_training_mixture`` +
+``StereoLoader`` over on-disk SceneFlow-layout trees, the SPMD train loop
+with device prefetch and on-device photometric jitter, periodic validation
+through ``eval.validate.make_validation_fn`` (the real FlyingThings
+validator), orbax checkpoints, and finally ``validate_things`` /
+``validate_kitti`` / ``cli.demo`` on the trained weights.
+
+Data is synthetic warped stereo at SceneFlow-native 540x960 (no network
+egress — BASELINE.md): textured multi-octave noise, right view = true
+horizontal warp of the left by a known smooth-plus-rectangles disparity
+field (tests/golden_data.py semantics, cv2-vectorized here), written in the
+exact on-disk layouts the real datasets use.  Held-out TEST scenes share
+the distribution, not the bytes.
+
+Orchestration (the default, ``--phase all``; parent never imports JAX so
+the one-claim TPU tunnel always belongs to exactly one child):
+  A. train from scratch; parent SIGTERMs the child mid-run; child
+     checkpoints at the step boundary and exits cleanly (the preemption
+     path, training/train_loop.py:220-246);
+  B. resume from the preemption checkpoint, train to completion;
+  C. eval: FlyingThings validator (iters=32 -> the deep-iters corr_fp32
+     guard engages), KITTI-resolution product path with FPS protocol, and
+     the demo CLI writing a jet PNG from the trained weights.
+Writes TRAINED_EVAL_r04.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, _REPO)
+
+WORK = "/tmp/trained_eval_r04"
+DATA = os.path.join(WORK, "datasets")
+CKPT = os.path.join(WORK, "ckpt")
+PROGRESS = os.path.join(WORK, "progress.jsonl")
+ARTIFACT = os.path.join(_REPO, "TRAINED_EVAL_r04.json")
+NAME = "r04"
+
+STEPS = 3000
+INTERRUPT_AT = 1000          # parent SIGTERMs once progress passes this step
+VALID_FREQ = 500
+N_TRAIN, N_TEST, N_KITTI = 120, 12, 70
+HW = (540, 960)              # SceneFlow-native frame size
+KITTI_HW = (375, 1242)
+POLL_S = 10.0                # orchestrator progress-poll interval
+SMOKE = False
+
+
+def _apply_smoke():
+    """Shrink everything so the FULL orchestration (SIGTERM included) runs
+    on CPU in minutes — the pre-flight for the chip run."""
+    global WORK, DATA, CKPT, PROGRESS, ARTIFACT, SMOKE
+    global STEPS, INTERRUPT_AT, VALID_FREQ, N_TRAIN, N_TEST, N_KITTI
+    global HW, KITTI_HW, POLL_S
+    SMOKE = True
+    WORK = "/tmp/trained_eval_smoke"
+    DATA = os.path.join(WORK, "datasets")
+    CKPT = os.path.join(WORK, "ckpt")
+    PROGRESS = os.path.join(WORK, "progress.jsonl")
+    ARTIFACT = os.path.join(WORK, "TRAINED_EVAL_smoke.json")
+    STEPS, INTERRUPT_AT, VALID_FREQ = 30, 10, 10
+    POLL_S = 0.3
+    N_TRAIN, N_TEST, N_KITTI = 10, 2, 52
+    HW = (96, 144)
+    KITTI_HW = (96, 144)
+
+
+# --------------------------------------------------------------- scene data
+def fast_pair(rng: np.random.Generator, h: int, w: int):
+    """textured left + known disparity + truly-warped right — the
+    tests/golden_data.py construction with the per-row np.interp warp
+    replaced by one cv2.remap (identical math: map_y is integral, so
+    bilinear degenerates to per-row linear; BORDER_REPLICATE == np.interp
+    edge clamping).  ~50x faster at 540x960."""
+    import cv2
+
+    from golden_data import disparity_field, textured_image
+
+    left = textured_image(rng, h, w)
+    disp = disparity_field(rng, h, w)
+    map_x = np.arange(w, dtype=np.float32)[None, :] + disp
+    map_y = np.broadcast_to(np.arange(h, dtype=np.float32)[:, None], (h, w))
+    right = cv2.remap(left, map_x, np.ascontiguousarray(map_y),
+                      cv2.INTER_LINEAR, borderMode=cv2.BORDER_REPLICATE)
+    return left, right, disp
+
+
+def _write_scene(seq_dir, disp_dir, left, right, disp):
+    from PIL import Image
+
+    from raft_stereo_tpu.data import frame_utils
+    os.makedirs(os.path.join(seq_dir, "left"), exist_ok=True)
+    os.makedirs(os.path.join(seq_dir, "right"), exist_ok=True)
+    os.makedirs(disp_dir, exist_ok=True)
+    Image.fromarray(left).save(os.path.join(seq_dir, "left", "0006.png"))
+    Image.fromarray(right).save(os.path.join(seq_dir, "right", "0006.png"))
+    frame_utils.write_pfm(os.path.join(disp_dir, "0006.pfm"), disp)
+
+
+def build_trees() -> None:
+    """SceneFlow TRAIN (finalpass + cleanpass symlink), FlyingThings TEST
+    (held out), and a KITTI-resolution tree for the product path."""
+    if os.path.exists(os.path.join(DATA, ".complete")):
+        return
+    t0 = time.time()
+    rng = np.random.default_rng(20260731)
+    ft = os.path.join(DATA, "FlyingThings3D")
+    for i in range(N_TRAIN):
+        left, right, disp = fast_pair(rng, *HW)
+        _write_scene(
+            os.path.join(ft, "frames_finalpass", "TRAIN", "A", f"{i:04d}"),
+            os.path.join(ft, "disparity", "TRAIN", "A", f"{i:04d}", "left"),
+            left, right, disp)
+    # the sceneflow recipe trains 4x clean + 4x final
+    # (core/stereo_datasets.py:292-296); real clean/final passes differ only
+    # in rendering effects, so one tree serves both via symlink
+    clean = os.path.join(ft, "frames_cleanpass")
+    if not os.path.exists(clean):
+        os.symlink(os.path.join(ft, "frames_finalpass"), clean)
+    for i in range(N_TEST):  # held out: fresh draws, TEST split
+        left, right, disp = fast_pair(rng, *HW)
+        _write_scene(
+            os.path.join(ft, "frames_finalpass", "TEST", "A", f"{i:04d}"),
+            os.path.join(ft, "disparity", "TEST", "A", f"{i:04d}", "left"),
+            left, right, disp)
+    from golden_data import make_kitti  # exact KITTI layout, sparse GT
+
+    # make_kitti draws via golden_data._pair (slow per-row warp); patch it
+    # through the fast path for the 70 full-res images
+    import golden_data as gd
+    orig = gd._pair
+    gd._pair = lambda r, h, w: fast_pair(r, h, w)
+    try:
+        make_kitti(os.path.join(DATA, "KITTI"), rng, n=N_KITTI, hw=KITTI_HW)
+    finally:
+        gd._pair = orig
+    open(os.path.join(DATA, ".complete"), "w").write("ok")
+    print(f"[trees] built {N_TRAIN}+{N_TEST} sceneflow + {N_KITTI} kitti "
+          f"scenes in {time.time() - t0:.0f}s", flush=True)
+
+
+# ------------------------------------------------------------------ configs
+def make_configs():
+    from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+
+    # The published architecture exactly as defaulted (3 GRU, hidden 128,
+    # corr 4x4, bf16 + remat — config.py mirrors train_stereo.py:233-240),
+    # with round-4 on-device photometric jitter feeding from one host core.
+    if SMOKE:
+        mcfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
+                                corr_levels=2, corr_radius=3,
+                                mixed_precision=True, corr_backend="reg")
+        tcfg = TrainConfig(batch_size=2, train_iters=3, valid_iters=4,
+                           lr=2e-4, num_steps=STEPS, image_size=(64, 96),
+                           train_datasets=("sceneflow",),
+                           validation_frequency=VALID_FREQ, seed=17,
+                           device_photometric=True)
+        return mcfg, tcfg
+    mcfg = RaftStereoConfig(mixed_precision=True)
+    tcfg = TrainConfig(batch_size=8, train_iters=22, valid_iters=32,
+                       lr=2e-4, num_steps=STEPS, image_size=(320, 720),
+                       train_datasets=("sceneflow",),
+                       validation_frequency=VALID_FREQ, seed=17,
+                       device_photometric=True)
+    return mcfg, tcfg
+
+
+# -------------------------------------------------------------- train phase
+def phase_train(restore: str | None) -> None:
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    from raft_stereo_tpu.eval.validate import make_validation_fn
+    from raft_stereo_tpu.training import logger as logger_mod
+    from raft_stereo_tpu.training.train_loop import train
+
+    mcfg, tcfg = make_configs()
+
+    prog = open(PROGRESS, "a", buffering=1)
+    step_holder = {"n": 0}
+    orig_push = logger_mod.Logger.push
+
+    def spy_push(self, metrics, lr=None):
+        step_holder["n"] += 1
+        prog.write(json.dumps({
+            "step": step_holder["n"] if not restore else None,
+            "loss": round(float(metrics["loss"]), 4),
+            "epe": round(float(metrics.get("epe", float("nan"))), 4),
+            "t": round(time.time(), 1)}) + "\n")
+        return orig_push(self, metrics, lr=lr)
+
+    logger_mod.Logger.push = spy_push
+
+    inner = make_validation_fn(mcfg, tcfg, data_root=DATA,
+                               datasets=("things",))
+
+    def validate_fn(variables, model_cfg=None):
+        res = inner(variables, model_cfg)
+        prog.write(json.dumps({"validation": res,
+                               "t": round(time.time(), 1)}) + "\n")
+        return res
+
+    state = train(mcfg, tcfg, name=NAME, data_root=DATA,
+                  checkpoint_dir=CKPT, restore=restore,
+                  log_dir=os.path.join(WORK, "runs"),
+                  validate_fn=validate_fn)
+    final_step = int(state.step)
+    status = "completed" if final_step >= STEPS else "interrupted"
+    prog.write(json.dumps({"phase_end": status, "step": final_step,
+                           "t": round(time.time(), 1)}) + "\n")
+    print(f"[train] {status} at step {final_step}", flush=True)
+
+
+# --------------------------------------------------------------- eval phase
+def phase_eval() -> None:
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.eval.validate import validate_kitti, validate_things
+    from raft_stereo_tpu.training.checkpoint import load_weights
+
+    ckpt_path = os.path.join(CKPT, NAME)
+    cfg, variables = load_weights(ckpt_path)
+
+    # iters=32 + bf16 => the deep-iters guard flips corr_fp32 (runner.py)
+    runner = InferenceRunner(cfg, variables, iters=32)
+    things = validate_things(runner, root=DATA)
+
+    kitti = validate_kitti(runner, root=os.path.join(DATA, "KITTI"))
+
+    # demo CLI on one held-out pair -> jet PNG from the trained weights
+    from raft_stereo_tpu.cli import demo as demo_cli
+    out_dir = os.path.join(WORK, "demo")
+    demo_cli.main([
+        "--restore_ckpt", ckpt_path,
+        "-l", os.path.join(DATA, "FlyingThings3D/frames_finalpass/TEST/A/"
+                           "0000/left/0006.png"),
+        "-r", os.path.join(DATA, "FlyingThings3D/frames_finalpass/TEST/A/"
+                           "0000/right/0006.png"),
+        "--output_directory", out_dir, "--save_numpy"])
+    # demo EPE vs the known GT: the product surface, quantified
+    from raft_stereo_tpu.data import frame_utils
+    gt = frame_utils.read_gen(os.path.join(
+        DATA, "FlyingThings3D/disparity/TEST/A/0000/left/0006.pfm"))
+    pred = np.load(os.path.join(out_dir, "0006.npy"))
+    demo_epe = float(np.mean(np.abs(pred - np.abs(gt))))
+
+    with open(os.path.join(WORK, "eval.json"), "w") as f:
+        json.dump({"things": things, "kitti": kitti,
+                   "demo_epe_px": round(demo_epe, 3),
+                   "device": str(jax.devices()[0].device_kind)}, f)
+    print(f"[eval] things={things} kitti={kitti} demo_epe={demo_epe:.3f}",
+          flush=True)
+
+
+# -------------------------------------------------------------- orchestrate
+def _spawn(phase_args):
+    if SMOKE:
+        phase_args = phase_args + ["--smoke"]
+    return subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)] + phase_args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _pump(proc, log_f):
+    for line in proc.stdout:
+        log_f.write(line)
+        log_f.flush()
+    return proc.wait()
+
+
+def _progress_steps() -> int:
+    try:
+        with open(PROGRESS) as f:
+            best = 0
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("step"):
+                    best = max(best, rec["step"])
+            return best
+    except FileNotFoundError:
+        return 0
+
+
+def orchestrate() -> None:
+    os.makedirs(WORK, exist_ok=True)
+    build_trees()
+    log_path = os.path.join(WORK, "run.log")
+    log_f = open(log_path, "a", buffering=1)
+    t_all = time.time()
+
+    # ---- phase A: train from scratch, SIGTERM mid-run
+    if os.path.exists(PROGRESS):
+        os.remove(PROGRESS)
+    a = _spawn(["--phase", "train"])
+    import threading
+    rc_holder = {}
+    pump = threading.Thread(target=lambda: rc_holder.update(
+        rc=_pump(a, log_f)), daemon=True)
+    pump.start()
+    sigterm_sent_at = None
+    while pump.is_alive():
+        time.sleep(POLL_S)
+        if sigterm_sent_at is None and _progress_steps() >= INTERRUPT_AT:
+            print(f"[orchestrate] progress >= {INTERRUPT_AT}: sending "
+                  f"SIGTERM to train child (pid {a.pid})", flush=True)
+            a.send_signal(signal.SIGTERM)
+            sigterm_sent_at = _progress_steps()
+    pump.join()
+    rc_a = rc_holder.get("rc")
+    if rc_a != 0:
+        raise SystemExit(f"phase A failed rc={rc_a}; see {log_path}")
+    interrupted_step = _progress_steps()
+    print(f"[orchestrate] phase A done: SIGTERM at ~{sigterm_sent_at}, "
+          f"checkpointed near step {interrupted_step}", flush=True)
+    time.sleep(2 if SMOKE else 20)  # tunnel claim release
+
+    # ---- phase B: resume from the preemption checkpoint, run to the end
+    b = _spawn(["--phase", "train", "--restore", os.path.join(CKPT, NAME)])
+    rc_b = _pump(b, log_f)
+    if rc_b != 0:
+        raise SystemExit(f"phase B failed rc={rc_b}; see {log_path}")
+    time.sleep(2 if SMOKE else 20)
+
+    # ---- phase C: evaluate the trained checkpoint
+    c = _spawn(["--phase", "eval"])
+    rc_c = _pump(c, log_f)
+    if rc_c != 0:
+        raise SystemExit(f"phase C failed rc={rc_c}; see {log_path}")
+    import shutil
+    demo_png = os.path.join(WORK, "demo", "0006-disparity.png")
+    if os.path.exists(demo_png):
+        shutil.copy(demo_png,
+                    os.path.join(_REPO, "docs", "demo_trained_r04.png"))
+
+    # ---- assemble the artifact
+    losses, validations, phase_ends = [], [], []
+    with open(PROGRESS) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "loss" in rec:
+                losses.append(rec["loss"])
+            if "validation" in rec:
+                validations.append(rec["validation"])
+            if "phase_end" in rec:
+                phase_ends.append(rec)
+    with open(os.path.join(WORK, "eval.json")) as f:
+        final_eval = json.load(f)
+
+    epes = [v.get("things-epe") for v in validations]
+    mcfg, tcfg = make_configs()
+    arch = (f"{mcfg.n_gru_layers} GRU, hidden {mcfg.hidden_dims[0]}, corr "
+            f"{mcfg.corr_levels}x{2 * mcfg.corr_radius + 1}, "
+            f"{'bf16+remat' if mcfg.mixed_precision else 'fp32'}, "
+            f"device_photometric")
+    rec = {
+        "metric": "trained_to_accuracy_product_eval",
+        "architecture": ("SMOKE " if SMOKE else "full published ") + arch,
+        "steps": STEPS,
+        "batch_hw_iters": [tcfg.batch_size, *tcfg.image_size,
+                           tcfg.train_iters],
+        "data": f"synthetic warped-stereo SceneFlow layout, {N_TRAIN} train "
+                f"/ {N_TEST} held-out TEST scenes at 540x960",
+        "loss_first100_mean": round(float(np.mean(losses[:100])), 3),
+        "loss_last100_mean": round(float(np.mean(losses[-100:])), 3),
+        "sigterm": {"requested_near_step": sigterm_sent_at,
+                    "checkpointed_at": interrupted_step,
+                    "resumed_and_completed": phase_ends[-1]["step"] >= STEPS},
+        "validation_epe_curve_px": [round(e, 3) for e in epes],
+        "heldout_epe_final_px": round(epes[-1], 3) if epes else None,
+        "product_kitti": {k: round(v, 3) for k, v in
+                          final_eval["kitti"].items()},
+        "demo_epe_px": final_eval["demo_epe_px"],
+        "device": final_eval["device"],
+        "wall_clock_min": round((time.time() - t_all) / 60, 1),
+    }
+    with open(ARTIFACT, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", default="all",
+                    choices=["all", "train", "eval", "trees"])
+    ap.add_argument("--restore", default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny everything: full-orchestration pre-flight "
+                         "on CPU")
+    args = ap.parse_args()
+    if args.smoke:
+        _apply_smoke()
+    os.makedirs(WORK, exist_ok=True)
+    if args.phase == "trees":
+        build_trees()
+    elif args.phase == "train":
+        build_trees()
+        phase_train(args.restore)
+    elif args.phase == "eval":
+        phase_eval()
+    else:
+        orchestrate()
+
+
+if __name__ == "__main__":
+    main()
