@@ -111,8 +111,10 @@ def test_train_step_single_device(rng):
 
 def test_remat_save_policies_bit_identical(rng):
     """config.remat_save only changes WHAT the backward recomputes, never
-    the math: loss and the updated params are bit-identical across save
-    policies (and the unknown-name case is rejected up front)."""
+    the math: loss and updated params agree across save policies to
+    executable-level reassociation (bit-exact on today's CPU XLA; compared
+    with a tight allclose because different policies are different
+    compiled programs).  The unknown-name case is rejected up front."""
     import dataclasses
 
     import pytest as _pytest
@@ -133,9 +135,10 @@ def test_remat_save_policies_bit_identical(rng):
         state2, metrics = make_train_step(tcfg, donate=False)(state, batch)
         results.append((float(metrics["loss"]),
                         jax.tree_util.tree_leaves(state2.params)))
-    assert results[0][0] == results[1][0]
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
     for a, b in zip(results[0][1], results[1][1], strict=True):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
 
 
 @pytest.mark.slow
