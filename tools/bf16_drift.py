@@ -170,6 +170,8 @@ def evaluate(tag, cfg_variables, scenes):
 
 
 def main():
+    import argparse
+
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
@@ -177,6 +179,13 @@ def main():
 
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.io.torch_import import import_torch_checkpoint
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", default=None,
+                    help="measure drift on THESE trained weights (orbax "
+                         "checkpoint dir, e.g. the round-4 trained_eval "
+                         "checkpoint) instead of the seeded/300-step pair")
+    args = ap.parse_args()
 
     realtime = RaftStereoConfig.realtime()
     scenes = make_band_scenes()
@@ -190,6 +199,23 @@ def main():
                                              mixed_precision=False),
                          variables),
         }
+
+    if args.ckpt:
+        # A CONVERGED network (tools/trained_eval.py trains to ~0.1 px
+        # held-out EPE) — the strongest setting for the drift question:
+        # round 3's "trained" rows were a 300-step warm-up and the large
+        # per-pixel drift concentrated where that network was itself
+        # unconverged.  Adds the shipped accuracy backend (reg_fused) as a
+        # 4th variant from the same weights.
+        from raft_stereo_tpu.training.checkpoint import load_weights
+        cfg, variables = load_weights(args.ckpt)
+        cfg = dataclasses.replace(cfg, corr_backend="alt",
+                                  mixed_precision=True)
+        variants = three_configs(cfg, variables)
+        variants["bf16_fused"] = (
+            dataclasses.replace(cfg, corr_backend="reg_fused"), variables)
+        evaluate("trained_checkpoint", variants, scenes)
+        return
 
     with tempfile.TemporaryDirectory() as td:
         pth = torch_seeded_pth(td)

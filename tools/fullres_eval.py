@@ -135,8 +135,9 @@ def main():
         "config": "accuracy arch + alt (no-volume) + banded encoder + bf16",
         "corr_fp32_auto": False,
         "bf16_corr_note": "fp32 corr would leave the fused VMEM path at "
-                          "this size; measured 32-iter bf16 drift is "
-                          "+0.04 px (BF16_DRIFT_r03.json)",
+                          "this size; measured 32-iter bf16 dEPE is "
+                          "<=0.05 px (BF16_DRIFT_r04.json trained rows; "
+                          "r03 warm-up rows agree)",
         "per_image_s": round(per_image_s, 2),
         "compiled_peak_hbm_gib": round(peak_gib, 3),
         "n_scenes": N_SCENES,
