@@ -109,6 +109,27 @@ class RaftStereoConfig:
     # instance/batch/none norms); incompatible with banded_encoder (pick
     # streaming OR sharding for the segment).
     rows_shards: int = 1
+    # Extend row sharding through the WHOLE refinement loop — correlation
+    # volume, per-iteration multilevel ConvGRU updates, convex upsampling
+    # (parallel/rows_gru.py: clamped extended windows, per-iteration
+    # ppermute halo refresh, window-restricted align-corners interp).  The
+    # O(H) heavyweights (full-res stem activations, corr volume, train-scan
+    # carries) stay sharded end to end; the static fine-level
+    # feature/context maps are replicated per device at the executor
+    # boundary (a deliberate sharding pin, see parallel/rows_gru.py).  This
+    # is what lets full-resolution TRAINING scale across chips: the train
+    # scan's per-iteration carries are O(H) and exceed one chip at
+    # Middlebury-F-class frames.  Requires rows_shards > 1 (the mesh axis),
+    # corr_w2_shards == 1, and fine-level height divisible by
+    # 4 * rows_shards with H/(2^n_downsample * rows_shards) >= 2 * halo.
+    rows_gru: bool = False
+    # Fine-level halo rows for rows_gru window exchange; None = derive from
+    # the architecture's per-iteration row receptive field
+    # (parallel/rows_gru.default_gru_halo: 16, or 32 for 3-level
+    # slow_fast_gru).  Must be a multiple of 4.  Smaller halos trade
+    # exactness for less overlap compute — parity holds only when the halo
+    # covers the receptive field.
+    rows_gru_halo: Optional[int] = None
     # Pixel count above which fnet processes the two images sequentially
     # instead of as one batch-2 concat (halves the full-resolution stem's
     # peak HBM).  None = derive from the local device's HBM at trace time
@@ -151,6 +172,22 @@ class RaftStereoConfig:
         if unknown:
             raise ValueError(f"remat_save names {sorted(unknown)} unknown; "
                              f"choose from {sorted(known_saves)}")
+        if self.rows_gru:
+            if self.rows_shards <= 1:
+                raise ValueError(
+                    "rows_gru extends rows_shards' context parallelism "
+                    "through the GRU loop — set rows_shards > 1")
+            if self.corr_w2_shards > 1:
+                raise ValueError(
+                    "rows_gru and corr_w2_shards>1 both reshard the "
+                    "correlation volume; the combination is unsupported — "
+                    "pick row sharding OR disparity-axis sharding")
+        if self.rows_gru_halo is not None and (self.rows_gru_halo < 8
+                                               or self.rows_gru_halo % 4):
+            raise ValueError(
+                f"rows_gru_halo={self.rows_gru_halo} must be a multiple of "
+                f"4, >= 8 (GRU pyramid alignment; see "
+                f"parallel/rows_gru.default_gru_halo)")
         if self.corr_w2_shards > 1 and self.corr_backend == "alt":
             raise ValueError(
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "

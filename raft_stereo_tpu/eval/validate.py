@@ -37,9 +37,10 @@ def single_device_cfg(cfg):
     single-device inference, the sharded executors are numerically
     equivalent (their parity tests), and they would demand an active mesh
     context inside the hook."""
-    if cfg.rows_shards > 1 or cfg.corr_w2_shards > 1:
+    if cfg.rows_shards > 1 or cfg.corr_w2_shards > 1 or cfg.rows_gru:
         import dataclasses
-        return dataclasses.replace(cfg, rows_shards=1, corr_w2_shards=1)
+        return dataclasses.replace(cfg, rows_shards=1, corr_w2_shards=1,
+                                   rows_gru=False)
     return cfg
 
 

@@ -214,13 +214,27 @@ class RAFTStereo(nn.Module):
             biases = self.context_zqr_convs[l](nn.relu(lv[1]))
             context.append(tuple(jnp.split(biases, 3, axis=-1)))
 
-        corr_fn = make_corr_fn(cfg, fmap1, fmap2)
-
         b, h8, w8, _ = net_list[0].shape
-        grid_x = coords_grid_x(b, h8, w8, dtype=jnp.float32)
         disp = jnp.zeros((b, h8, w8), jnp.float32)
         if flow_init is not None:
             disp = disp + flow_init
+
+        if cfg.rows_gru and not self.is_initializing():
+            # Context parallelism through the WHOLE refinement loop: the
+            # correlation volume, per-iteration GRU updates, and convex
+            # upsampling all run with image rows sharded over the active
+            # mesh's rows axis (parallel/rows_gru.py).  ``use_rows`` is
+            # necessarily True here (config validation requires
+            # rows_shards > 1), so the encoder trunk above already ran
+            # sharded on the same, already-validated (rows_mesh, rows_axis).
+            from raft_stereo_tpu.parallel.rows_gru import rows_sharded_gru_loop
+            return rows_sharded_gru_loop(
+                cfg, dtype, self.update_block.variables["params"],
+                fmap1, fmap2, net_list, context, disp, iters, test_mode,
+                rows_mesh, rows_axis)
+
+        corr_fn = make_corr_fn(cfg, fmap1, fmap2)
+        grid_x = coords_grid_x(b, h8, w8, dtype=jnp.float32)
 
         n = cfg.n_gru_layers
 

@@ -11,7 +11,7 @@ rebuilt (SURVEY.md §2).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -99,6 +99,12 @@ class BasicMultiUpdateBlock(nn.Module):
 
     config: RaftStereoConfig
     dtype: Optional[Any] = None
+    # Cross-resolution upsampling override.  The align-corners bilinear
+    # interp's sampling grid depends on GLOBAL tensor heights, so the
+    # row-sharded context-parallel executor (parallel/rows_gru.py) supplies
+    # per-device window-restricted matrices here; None = the ordinary
+    # whole-tensor ``interp_like``.  No effect on parameters.
+    interp_fn: Optional[Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None
 
     @nn.compact
     def __call__(self, net: Sequence[jnp.ndarray],
@@ -111,6 +117,7 @@ class BasicMultiUpdateBlock(nn.Module):
         hd = cfg.hidden_dims  # fine → coarse
         n = cfg.n_gru_layers
         net = list(net)
+        interp = self.interp_fn or interp_like
 
         # GRU input dims mirror reference core/update.py:104-106 under our
         # fine→coarse indexing.
@@ -121,7 +128,7 @@ class BasicMultiUpdateBlock(nn.Module):
             if n > 2:
                 net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
                     net[1], context[1], pool2x(net[0]),
-                    interp_like(net[2], net[1]))
+                    interp(net[2], net[1]))
             else:
                 net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
                     net[1], context[1], pool2x(net[0]))
@@ -130,7 +137,7 @@ class BasicMultiUpdateBlock(nn.Module):
                 flow, corr)
             if n > 1:
                 net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
-                    net[0], context[0], motion, interp_like(net[1], net[0]))
+                    net[0], context[0], motion, interp(net[1], net[0]))
             else:
                 net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
                     net[0], context[0], motion)
