@@ -68,8 +68,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_stereo_tpu.config import RaftStereoConfig
 from raft_stereo_tpu.ops.grids import coords_grid_x
-from raft_stereo_tpu.ops.resize import (_interp_matrix,
-                                        resize_bilinear_align_corners)
+from raft_stereo_tpu.ops.resize import _interp_matrix
 from raft_stereo_tpu.ops.upsample import convex_upsample
 
 
@@ -128,8 +127,15 @@ def _make_window_interp(row_mats):
         sh, sw = x.shape[1], x.shape[2]
         dh, dw = dest.shape[1], dest.shape[2]
         m = row_mats.get((sh, dh))
-        if m is None:  # pragma: no cover - defensive; all sites are registered
-            return resize_bilinear_align_corners(x, (dh, dw))
+        if m is None:
+            # A window-local align-corners resize would be SILENTLY wrong
+            # (its grid must come from GLOBAL heights — module docstring);
+            # fail at trace time instead.
+            raise KeyError(
+                f"rows_gru: no restricted interp matrix for window rows "
+                f"{sh}->{dh}; registered sites: {sorted(row_mats)} — a new "
+                f"update-block interp site must be added to the executor's "
+                f"interp_shapes")
         y = jnp.einsum("bhwc,oh->bowc", x, m.astype(x.dtype),
                        precision=jax.lax.Precision.HIGHEST)
         if sw != dw:

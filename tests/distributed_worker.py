@@ -1,14 +1,24 @@
-"""Worker for the real 2-process distributed test (test_distributed.py).
+"""Worker for the real 2-process distributed tests (test_distributed.py).
 
 Each process: ``jax.distributed.initialize`` over a localhost coordinator,
-2 local virtual CPU devices (4 global), a (4, 1) mesh spanning both
-processes, and two SPMD train steps where each process contributes only its
-LOCAL slice of the global batch (``shard_batch`` →
-``jax.make_array_from_process_local_data`` — the branch single-process runs
-can never reach).  Writes the final params and losses for the parent test
-to compare across processes and against a single-process run.
+2 local virtual CPU devices (4 global), a mesh spanning both processes, and
+two SPMD train steps.  Two modes:
 
-Usage: python distributed_worker.py <pid> <nproc> <coord_addr> <out.npz>
+* ``data`` — a (4,) data mesh; each process contributes only its LOCAL
+  slice of the global batch (``shard_batch`` →
+  ``jax.make_array_from_process_local_data`` — the branch single-process
+  runs can never reach).
+* ``rows`` — a (data=2, corr=1, rows=2) mesh with the ROWS axis laid
+  ACROSS the two processes (device order [p0d0, p1d0, p0d1, p1d1]), so the
+  full-loop context-parallel executor's per-iteration halo ``ppermute``
+  rides the cross-process link — the multi-host analog of sequence
+  parallelism over DCN.  Each process passes the full global batch (its
+  devices hold a piece of every sample).
+
+Writes the final params and losses for the parent test to compare across
+processes and against a single-process run.
+
+Usage: python distributed_worker.py <pid> <nproc> <coord> <out.npz> [mode]
 """
 
 import os
@@ -18,6 +28,7 @@ import sys
 def main():
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     coord, out_path = sys.argv[3], sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "data"
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from _hermetic import force_cpu
@@ -29,22 +40,45 @@ def main():
     assert jax.process_count() == nproc
     assert jax.device_count() == 2 * nproc
 
+    import contextlib
+
     import jax.numpy as jnp
     import numpy as np
 
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
     from raft_stereo_tpu.parallel import distributed
-    from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from raft_stereo_tpu.parallel.mesh import (ROWS_AXIS, make_mesh,
+                                               replicate, shard_batch)
+    from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
     from raft_stereo_tpu.training.state import create_train_state
     from raft_stereo_tpu.training.step import make_train_step
 
-    mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), corr_levels=2,
-                            fnet_dim=32)
-    tcfg = TrainConfig(batch_size=8, train_iters=2, num_steps=10,
-                      image_size=(32, 48))
-    state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
-                               image_shape=(1, 32, 48, 3))
-    mesh = make_mesh(n_data=4)
+    if mode == "rows":
+        mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,),
+                                corr_levels=2, fnet_dim=32,
+                                rows_shards=2, rows_gru=True,
+                                rows_gru_halo=12)
+        h, w, batch = 192, 64, 2
+        tcfg = TrainConfig(batch_size=batch, train_iters=2, num_steps=10,
+                           image_size=(h, w), data_parallel=2)
+        # rows ACROSS processes: grid[data, corr, rows] with rows pairs
+        # (p0d0, p1d0) and (p0d1, p1d1).
+        devs = jax.devices()
+        mesh = make_mesh(n_data=2, n_corr=1, n_rows=2,
+                         devices=[devs[0], devs[2], devs[1], devs[3]])
+        mesh_ctx = lambda: rows_sharding(mesh, axis=ROWS_AXIS)  # noqa: E731
+    else:
+        mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,),
+                                corr_levels=2, fnet_dim=32)
+        h, w, batch = 32, 48, 8
+        tcfg = TrainConfig(batch_size=batch, train_iters=2, num_steps=10,
+                           image_size=(h, w))
+        mesh = make_mesh(n_data=4)
+        mesh_ctx = contextlib.nullcontext
+
+    with mesh_ctx():
+        state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                                   image_shape=(1, h, w, 3))
     state = replicate(state, mesh)
     step_fn = make_train_step(tcfg, mesh=mesh, donate=False)
 
@@ -52,18 +86,25 @@ def main():
     assert distributed.any_process(False) is False
     assert distributed.any_process(pid == 0) is True
 
-    local = 8 // nproc
+    local = batch // nproc
     losses = []
     for step in range(2):
         rng = np.random.default_rng(100 + step)  # same GLOBAL batch everywhere
         g = {
-            "image1": rng.uniform(0, 255, (8, 32, 48, 3)).astype(np.float32),
-            "image2": rng.uniform(0, 255, (8, 32, 48, 3)).astype(np.float32),
-            "flow": rng.normal(0, 5, (8, 32, 48)).astype(np.float32),
-            "valid": np.ones((8, 32, 48), np.float32),
+            "image1": rng.uniform(0, 255, (batch, h, w, 3)).astype(np.float32),
+            "image2": rng.uniform(0, 255, (batch, h, w, 3)).astype(np.float32),
+            "flow": rng.normal(0, 5, (batch, h, w)).astype(np.float32),
+            "valid": np.ones((batch, h, w), np.float32),
         }
-        local_batch = {k: v[pid * local:(pid + 1) * local] for k, v in g.items()}
-        state, metrics = step_fn(state, shard_batch(local_batch, mesh))
+        if mode == "rows":
+            # rows spans processes, so every process's devices hold a piece
+            # of every sample — the process-local data IS the global batch.
+            local_batch = g
+        else:
+            local_batch = {k: v[pid * local:(pid + 1) * local]
+                           for k, v in g.items()}
+        with mesh_ctx():
+            state, metrics = step_fn(state, shard_batch(local_batch, mesh))
         losses.append(float(metrics["loss"]))
 
     # fully-replicated state: every process can read it

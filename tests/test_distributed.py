@@ -66,38 +66,7 @@ def test_two_process_training_matches_single(tmp_path):
     coordinator, batch assembled with make_array_from_process_local_data,
     two SPMD steps.  Both processes must agree bit-exactly with each other,
     and match a single-process run of the same global batches."""
-    import os
-    import socket
-    import subprocess
-    import sys
-
-    sock = socket.socket()
-    sock.bind(("localhost", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-    coord = f"localhost:{port}"
-
-    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
-    outs = [str(tmp_path / f"proc{i}.npz") for i in range(2)]
-    env = {**os.environ, "PYTHONPATH": os.pathsep.join(
-        [os.path.dirname(os.path.dirname(worker))]
-        + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), "2", coord, outs[i]],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for i in range(2)]
-    logs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        logs.append(out.decode(errors="replace"))
-    for p, log_text in zip(procs, logs):
-        assert p.returncode == 0, log_text[-3000:]
-
+    outs = _spawn_workers(tmp_path, "data")
     r0, r1 = np.load(outs[0]), np.load(outs[1])
     # replicated state must be IDENTICAL across processes
     np.testing.assert_array_equal(r0["params"], r1["params"])
@@ -139,4 +108,91 @@ def test_two_process_training_matches_single(tmp_path):
     # max |diff| ~3e-4 over 2 steps at lr=2e-4.  Losses above agree to 1e-6;
     # bit-exactness is asserted ACROSS PROCESSES (the SPMD guarantee), not
     # across collective implementations.
+    np.testing.assert_allclose(r0["params"], flat, rtol=0, atol=5e-4)
+
+
+def _spawn_workers(tmp_path, mode):
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    sock = socket.socket()
+    sock.bind(("localhost", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    coord = f"localhost:{port}"
+
+    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+    outs = [str(tmp_path / f"proc{i}.npz") for i in range(2)]
+    env = {**os.environ, "PYTHONPATH": os.pathsep.join(
+        [os.path.dirname(os.path.dirname(worker))]
+        + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", coord, outs[i], mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    # Drain BOTH workers before asserting: a first-worker failure must not
+    # leak the second as an orphan blocked on the dead coordinator, and
+    # both logs should be available for diagnosis.
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(out.decode(errors="replace"))
+    for p, log_text in zip(procs, logs):
+        assert p.returncode == 0, log_text[-3000:]
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_rows_gru_training_matches_single(tmp_path):
+    """REAL 2-process run with the ROWS axis laid ACROSS the processes: the
+    full-loop context-parallel executor's per-iteration halo ppermute rides
+    the cross-process link (the multi-host analog of sequence parallelism
+    over DCN).  Both processes agree bit-exactly; the run matches a
+    single-process (data=2, rows=2) mesh on the same global batches."""
+    outs = _spawn_workers(tmp_path, "rows")
+    r0, r1 = np.load(outs[0]), np.load(outs[1])
+    np.testing.assert_array_equal(r0["params"], r1["params"])
+    np.testing.assert_array_equal(r0["losses"], r1["losses"])
+
+    import jax
+
+    from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+    from raft_stereo_tpu.parallel.mesh import ROWS_AXIS, make_mesh, \
+        replicate, shard_batch
+    from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
+    from raft_stereo_tpu.training.state import create_train_state
+    from raft_stereo_tpu.training.step import make_train_step
+
+    mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), corr_levels=2,
+                            fnet_dim=32, rows_shards=2, rows_gru=True,
+                            rows_gru_halo=12)
+    h, w, batch = 192, 64, 2
+    tcfg = TrainConfig(batch_size=batch, train_iters=2, num_steps=10,
+                       image_size=(h, w), data_parallel=2)
+    mesh = make_mesh(n_data=2, n_corr=1, n_rows=2, devices=jax.devices()[:4])
+    with rows_sharding(mesh, axis=ROWS_AXIS):
+        state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                                   image_shape=(1, h, w, 3))
+    state = replicate(state, mesh)
+    step_fn = make_train_step(tcfg, mesh=mesh, donate=False)
+    losses = []
+    for step in range(2):
+        rng = np.random.default_rng(100 + step)
+        g = {"image1": rng.uniform(0, 255, (batch, h, w, 3)).astype(np.float32),
+             "image2": rng.uniform(0, 255, (batch, h, w, 3)).astype(np.float32),
+             "flow": rng.normal(0, 5, (batch, h, w)).astype(np.float32),
+             "valid": np.ones((batch, h, w), np.float32)}
+        with rows_sharding(mesh, axis=ROWS_AXIS):
+            state, metrics = step_fn(state, shard_batch(g, mesh))
+        losses.append(float(metrics["loss"]))
+    flat = np.concatenate([np.ravel(np.asarray(jax.device_get(x)))
+                           for x in jax.tree_util.tree_leaves(state.params)])
+    np.testing.assert_allclose(r0["losses"], np.asarray(losses), rtol=1e-6)
     np.testing.assert_allclose(r0["params"], flat, rtol=0, atol=5e-4)

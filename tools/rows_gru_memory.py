@@ -74,8 +74,14 @@ def mesh_scaling(args):
 
     h, w = args.height, args.width
     for n_rows in (1, 2, 4, 8):
+        # fp32 on the CPU mesh: XLA's CPU backend aborts ("Invalid binary
+        # instruction opcode copy", hlo_instruction.cc) compiling the bf16
+        # BACKWARD of the row-sharded loop — a backend compiler bug
+        # (fp32 grads and bf16 forward both compile clean; single-device
+        # bf16 training on the TPU backend is measured working).  The 1/N
+        # scaling ratio this measurement exists for is dtype-independent.
         model_cfg = RaftStereoConfig(
-            corr_backend="alt", mixed_precision=True,
+            corr_backend="alt", mixed_precision=False,
             rows_shards=n_rows, rows_gru=n_rows > 1, rows_gru_halo=12)
         train_cfg = TrainConfig(batch_size=1, train_iters=args.iters,
                                 image_size=(h, w), data_parallel=1)
@@ -100,27 +106,42 @@ def mesh_scaling(args):
 
 
 def chip_wall(args):
+    import re
+
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
     from raft_stereo_tpu.profiling import device_hbm_bytes
 
     budget = device_hbm_bytes()
     for h, w in [tuple(map(int, s.split("x"))) for s in args.shapes]:
         model_cfg = RaftStereoConfig(corr_backend="alt",
-                                     mixed_precision=True)
+                                     mixed_precision=True,
+                                     banded_encoder=args.banded)
         train_cfg = TrainConfig(batch_size=1, train_iters=args.iters,
                                 image_size=(h, w), data_parallel=1)
-        compiled = _train_step_compiled(model_cfg, train_cfg, None, (h, w))
-        ma = compiled.memory_analysis()
-        peak = getattr(ma, "peak_memory_in_bytes", 0) or (
-            ma.temp_size_in_bytes + ma.argument_size_in_bytes)
-        print(json.dumps({
-            "metric": "fullres_train_single_chip_hbm",
-            "image": f"{h}x{w}", "iters": args.iters,
-            "peak_hbm_gib": round(peak / 2**30, 3),
-            "device_hbm_gib": round(budget / 2**30, 2),
-            "fits": bool(peak < budget),
-            "unit": "GiB (compiled.memory_analysis, compile-only)",
-        }), flush=True)
+        row = {"metric": "fullres_train_single_chip_hbm",
+               "image": f"{h}x{w}", "iters": args.iters,
+               "banded_encoder": args.banded,
+               "device_hbm_gib": round(budget / 2**30, 2)}
+        try:
+            compiled = _train_step_compiled(model_cfg, train_cfg, None,
+                                            (h, w))
+            ma = compiled.memory_analysis()
+            peak = getattr(ma, "peak_memory_in_bytes", 0) or (
+                ma.temp_size_in_bytes + ma.argument_size_in_bytes)
+            row.update(peak_hbm_gib=round(peak / 2**30, 3),
+                       fits=bool(peak < budget),
+                       unit="GiB (compiled.memory_analysis, compile-only)")
+        except Exception as e:
+            # The remote TPU compiler refuses outright past the wall; its
+            # message carries the honest number ("Used X of Y hbm").  Any
+            # OTHER failure is a tool/environment error, not a measurement —
+            # re-raise so it can't masquerade as a fits=false datapoint.
+            m = re.search(r"Used ([0-9.]+)G of ([0-9.]+)G hbm", str(e))
+            if m is None:
+                raise
+            row.update(fits=False, peak_hbm_gib=float(m.group(1)),
+                       unit="GiB (XLA:TPU compile OOM message)")
+        print(json.dumps(row), flush=True)
 
 
 def main():
@@ -130,6 +151,9 @@ def main():
     p.add_argument("--height", type=int, default=768)
     p.add_argument("--width", type=int, default=256)
     p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--banded", action="store_true",
+                   help="chip-wall with the banded (streaming) encoder — "
+                        "the single-chip alternative to row sharding")
     p.add_argument("--shapes", nargs="+",
                    default=["512x736", "992x1440", "1984x2880"])
     args = p.parse_args()
