@@ -75,10 +75,17 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     """Place a host batch on the mesh, sharded along the leading (batch) dim.
 
     Single-host: a plain ``device_put``.  Multi-host (mesh spans processes):
-    each process passes its LOCAL shard of the global batch — leading dim =
-    global_batch // process_count — and the global array is assembled with
+    each process passes the portion of the global batch its OWN devices
+    address, and the global array is assembled with
     ``jax.make_array_from_process_local_data`` (``device_put`` cannot place a
-    host-local array onto another process's devices)."""
+    host-local array onto another process's devices).  With the default mesh
+    layout the ``data`` axis is process-contiguous, so that portion is the
+    process's slice (leading dim = global_batch // process_count); when
+    another axis spans processes instead (e.g. the rows-across-processes
+    layout in tests/distributed_worker.py), every process's devices address
+    every data-axis row and the process-local portion is the FULL global
+    batch — which data rows a process passes depends on which data shards
+    its devices hold, not on process count alone."""
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
         return jax.tree_util.tree_map(

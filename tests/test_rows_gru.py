@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
 
@@ -203,3 +203,50 @@ def test_rows_gru_slow_fast_two_level(rng):
         )(v, img1, img2)
     np.testing.assert_allclose(np.asarray(up_r), np.asarray(up_ref),
                                rtol=1e-3, atol=5e-3)
+
+
+@pytest.mark.slow
+def test_rows_gru_train_loop_auto_wires(tmp_path, rng):
+    """train() with rows_gru=True: the loop builds the mesh, holds the
+    rows_sharding context around tracing, steps the FULL-loop sharded
+    executor end to end (loader, device prefetch, checkpointing), and the
+    periodic validator's single-device normalization strips rows_gru."""
+    from raft_stereo_tpu.training.train_loop import train
+
+    cfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), fnet_dim=64,
+                           corr_levels=2, corr_radius=3, corr_backend="reg",
+                           rows_shards=2, rows_gru=True, rows_gru_halo=12)
+    tcfg = TrainConfig(batch_size=2, train_iters=2, valid_iters=2,
+                       num_steps=2, image_size=(192, 64), data_parallel=1,
+                       validation_frequency=2, seed=3)
+
+    class Stream:
+        def __iter__(self):
+            gen = np.random.default_rng(7)
+            while True:
+                yield {
+                    "image1": gen.integers(0, 256, (2, 192, 64, 3)).astype(
+                        np.uint8),
+                    "image2": gen.integers(0, 256, (2, 192, 64, 3)).astype(
+                        np.uint8),
+                    "flow": gen.uniform(-8, 0, (2, 192, 64)).astype(
+                        np.float32),
+                    "valid": np.ones((2, 192, 64), np.float32)}
+
+    seen = {}
+
+    def validate_fn(variables, model_cfg=None):
+        seen["cfg"] = model_cfg
+        return {"probe": 1.0}
+
+    state = train(cfg, tcfg, name="rows_gru",
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  log_dir=str(tmp_path / "runs"), loader=Stream(),
+                  validate_fn=validate_fn)
+    assert int(state.step) == 2
+    assert seen["cfg"].rows_gru  # authoritative cfg reaches the hook
+    from raft_stereo_tpu.eval.validate import single_device_cfg
+    norm = single_device_cfg(seen["cfg"])
+    assert not norm.rows_gru and norm.rows_shards == 1
+    leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(state.params)]
+    assert all(np.all(np.isfinite(l)) for l in leaves)

@@ -139,7 +139,11 @@ def chip_wall(args):
             m = re.search(r"Used ([0-9.]+)G of ([0-9.]+)G hbm", str(e))
             if m is None:
                 raise
+            # both numbers from the same message so the row is
+            # self-consistent (the local HBM query may differ from the
+            # compiler's budget, e.g. 16.0 vs 15.75)
             row.update(fits=False, peak_hbm_gib=float(m.group(1)),
+                       device_hbm_gib=float(m.group(2)),
                        unit="GiB (XLA:TPU compile OOM message)")
         print(json.dumps(row), flush=True)
 
