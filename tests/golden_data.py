@@ -135,8 +135,11 @@ def layered_scene(rng: np.random.Generator, h: int, w: int,
     # --- layers: (a, b, c) plane in left/canvas coords, mask, texture ----
     layers = []
     bg_d0 = float(rng.uniform(1.0, 0.25 * d_ceiling))
+    # |c| < bg_d0 - 0.5 keeps the background disparity positive everywhere,
+    # so the background plane covers every right-view pixel (no holes)
+    c_cap = min(0.1 * d_ceiling, max(bg_d0 - 0.5, 0.0))
     a, b, c = bg_d0, float(rng.uniform(0.0, 0.2 * d_ceiling)), \
-        float(rng.uniform(-0.1, 0.1) * d_ceiling)
+        float(rng.uniform(-c_cap, c_cap))
     bg_tex = textured_image(rng, h, w_ext).astype(np.float32)
     # carve one textureless patch into the background
     py0, px0 = int(rng.integers(0, h // 2)), int(rng.integers(0, w_ext // 2))
@@ -198,8 +201,10 @@ def layered_scene(rng: np.random.Generator, h: int, w: int,
     xm = np.clip(xmatch, 0, w - 1)
     x0 = np.clip(np.floor(xm).astype(np.int64), 0, w - 2)
     fr = xm - x0
-    dr0 = np.take_along_axis(disp_r, x0, axis=1)
-    dr1 = np.take_along_axis(disp_r, x0 + 1, axis=1)
+    # guard -inf (a right pixel no layer covered) against 0*inf = nan
+    disp_r_f = np.nan_to_num(disp_r, neginf=-1e9)
+    dr0 = np.take_along_axis(disp_r_f, x0, axis=1)
+    dr1 = np.take_along_axis(disp_r_f, x0 + 1, axis=1)
     dr_at_match = dr0 * (1 - fr) + dr1 * fr
     occ = off_frame | (dr_at_match > disp_l + 1.01)
 
