@@ -58,7 +58,8 @@ def main():
     # --- product path: the real KITTI validator over a synthetic tree
     with tempfile.TemporaryDirectory(prefix="kittibench_") as td:
         root = os.path.join(td, "KITTI")
-        make_kitti(root, np.random.default_rng(0), n=N_IMAGES, hw=KITTI_HW)
+        make_kitti(root, np.random.default_rng(0), n=N_IMAGES, hw=KITTI_HW,
+                   hard=True)
         runner = InferenceRunner(cfg, variables, iters=ITERS)
         res = validate_kitti(runner, root=root)
 
@@ -77,6 +78,20 @@ def main():
         runner.run_batch(lefts, rights)  # compile + warm
         batched = [runner.run_batch(lefts, rights)[1] for _ in range(5)]
         batched_s = float(np.median(batched)) / BATCHED_N
+        flows_fp32, _ = runner.run_batch(lefts, rights)
+
+        # --- half-precision fetch (round 5): the flow is cast fp16 ON
+        # DEVICE before the fetch, halving the down-leg bytes that dominate
+        # the batched path (PRODUCT_r04: batched mode reached only 59% of
+        # the fp32-fetch ceiling; the fetch leg was 162.7 ms/image).
+        runner16 = InferenceRunner(cfg, variables, iters=ITERS,
+                                   fetch_dtype="fp16")
+        runner16.run_batch(lefts, rights)  # compile + warm
+        batched16 = [runner16.run_batch(lefts, rights)[1] for _ in range(5)]
+        batched16_s = float(np.median(batched16)) / BATCHED_N
+        flows_fp16, _ = runner16.run_batch(lefts, rights)
+        # pure fetch-rounding error — bounds any EPE delta from above
+        fetch_roundoff_px = float(np.abs(flows_fp16 - flows_fp32).mean())
 
     # --- bare forward at the same padded shape (bench.py's method)
     h = -(-KITTI_HW[0] // 32) * 32
@@ -114,6 +129,9 @@ def main():
     big = jnp.zeros(KITTI_HW, jnp.float32) + 1.0
     jax.device_get(big)
     down_ms = med(lambda i: np.asarray(big + np.float32(i))) - rtt_ms
+    big16 = jnp.zeros(KITTI_HW, jnp.float16) + jnp.float16(1.0)
+    jax.device_get(big16)
+    down16_ms = med(lambda i: np.asarray(big16 + np.float16(i))) - rtt_ms
 
     fps_product = res["kitti-fps"]
     fps_bare = 1.0 / bare_s
@@ -126,7 +144,9 @@ def main():
     # Clamp: on a LOCAL (non-tunneled) device the median-minus-RTT probes
     # can come out ~0 or negative — report no ceiling instead of nonsense.
     transfer_floor_s = (up_ms + down_ms) / 1e3
+    transfer_floor16_s = (up_ms + down16_ms) / 1e3
     has_floor = transfer_floor_s > 1e-4
+    has_floor16 = transfer_floor16_s > 1e-4
     rec = {
         "metric": "product_path_fps_kitti",
         "value": round(fps_product, 2),
@@ -137,6 +157,14 @@ def main():
             round(1.0 / transfer_floor_s, 2) if has_floor else None),
         "batched_vs_bandwidth_ceiling": (
             round(transfer_floor_s / batched_s, 3) if has_floor else None),
+        "batched_fp16_fetch_fps": round(1.0 / batched16_s, 2),
+        "fp16_fetch_ceiling_fps": (
+            round(1.0 / transfer_floor16_s, 2) if has_floor16 else None),
+        "batched_fp16_vs_its_ceiling": (
+            round(transfer_floor16_s / batched16_s, 3) if has_floor16
+            else None),
+        "fp16_fetch_roundoff_px": round(fetch_roundoff_px, 5),
+        "tunnel_fetch_flow_fp16_ms": round(down16_ms, 1),
         "bare_forward_fps": round(fps_bare, 2),
         "gap": round(fps_product / fps_bare, 3),
         "per_image_overhead_ms": round(1e3 * (1 / fps_product - bare_s), 2),
@@ -147,7 +175,7 @@ def main():
         "n_timed": N_IMAGES - 50,  # FpsProtocol times images 51..N
     }
     print(json.dumps(rec))
-    with open(os.path.join(_REPO, "PRODUCT_r04.json"), "w") as f:
+    with open(os.path.join(_REPO, "PRODUCT_r05.json"), "w") as f:
         f.write(json.dumps(rec) + "\n")
 
 

@@ -39,6 +39,23 @@ def add_arch_overrides(parser: argparse.ArgumentParser):
                         help="stream full-resolution encoder stages in "
                              "bands (several-fold lower peak HBM for huge "
                              "frames; ~20%% slower)")
+    # context parallelism — one flag, like the reference's invisible
+    # DataParallel (train_stereo.py:134), but across the rows axis of a
+    # device mesh (parallel/rows_sharded.py, parallel/rows_gru.py)
+    parser.add_argument("--rows_shards", type=int, default=None,
+                        help="shard image rows over this many mesh devices "
+                             "(context parallelism for the encoder trunk)")
+    parser.add_argument("--rows_gru", action="store_true",
+                        help="extend rows sharding through the corr volume, "
+                             "GRU iterations, and upsample (full-loop "
+                             "context parallelism; requires --rows_shards)")
+    parser.add_argument("--rows_gru_halo", type=int, default=None,
+                        help="fine-level halo rows for --rows_gru window "
+                             "exchange (default: derived from the GRU "
+                             "receptive field)")
+    parser.add_argument("--corr_w2_shards", type=int, default=None,
+                        help="shard the correlation volume's W2 axis over "
+                             "this many mesh devices")
 
 
 def arch_overrides(args) -> Dict[str, Any]:
@@ -51,6 +68,14 @@ def arch_overrides(args) -> Dict[str, Any]:
         out["mixed_precision"] = True
     if args.banded_encoder:
         out["banded_encoder"] = True
+    if args.rows_shards:
+        out["rows_shards"] = args.rows_shards
+    if args.rows_gru:
+        out["rows_gru"] = True
+    if args.rows_gru_halo is not None:
+        out["rows_gru_halo"] = args.rows_gru_halo
+    if args.corr_w2_shards:
+        out["corr_w2_shards"] = args.corr_w2_shards
     return out
 
 

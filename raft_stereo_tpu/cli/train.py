@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--name", default="raft-stereo")
     p.add_argument("--restore_ckpt", default=None,
                    help=".pth (warm start) or orbax dir (exact resume)")
+    p.add_argument("--warm_start", action="store_true",
+                   help="load WEIGHTS ONLY from an orbax --restore_ckpt "
+                        "(fresh optimizer/schedule — the fine-tune path)")
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
@@ -136,7 +139,7 @@ def main(argv=None):
                  data_root=args.data_root,
                  checkpoint_dir=args.checkpoint_dir,
                  restore=args.restore_ckpt, log_dir=args.log_dir,
-                 validate_fn=validate_fn)
+                 validate_fn=validate_fn, warm_start=args.warm_start)
 
 
 if __name__ == "__main__":

@@ -42,7 +42,8 @@ class InferenceRunner:
                  iters: int = 32, divis_by: int = 32,
                  shape_bucket: Optional[int] = None,
                  max_cached_shapes: int = 16,
-                 corr_fp32_auto: bool = True):
+                 corr_fp32_auto: bool = True,
+                 fetch_dtype: Optional[str] = None):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -55,7 +56,17 @@ class InferenceRunner:
         ``corr_fp32`` gets it enabled here (with a one-line warning) —
         the measured 32-iter drift on trained weights is the reason
         (BF16_DRIFT_r03.json).  Pass False to measure raw bf16 numerics
-        (tools/bf16_drift.py does)."""
+        (tools/bf16_drift.py does).
+        ``fetch_dtype`` ("fp16" | "bf16" | None): cast the flow on DEVICE
+        before the device->host fetch, halving the down-leg bytes — the
+        dominant cost of the product path behind a bandwidth-bound tunnel
+        (PRODUCT_r04.json: 162.7 ms/image fp32 fetch).  fp16 is the right
+        half precision for a disparity map: |flow| < 2048 everywhere the
+        metrics are defined (|d| < 192 — evaluate_stereo.py:133-135), so
+        the worst ulp is 0.125 px at the far end and the mean rounding
+        error is ~ulp/4, far below metric noise; bf16's 8-bit mantissa
+        would round 190 px to ±0.75 px.  Results are returned as float32
+        regardless."""
         if shape_bucket is not None and shape_bucket % divis_by:
             raise ValueError(f"shape_bucket={shape_bucket} must be a "
                              f"multiple of the model's /{divis_by} "
@@ -81,6 +92,11 @@ class InferenceRunner:
         self.iters = iters
         self.divis_by = shape_bucket or divis_by
         self.max_cached_shapes = max_cached_shapes
+        if fetch_dtype not in (None, "fp16", "bf16"):
+            raise ValueError(f"fetch_dtype={fetch_dtype!r}: use 'fp16', "
+                             f"'bf16', or None (full fp32 fetch)")
+        self.fetch_dtype = {None: None, "fp16": jnp.float16,
+                            "bf16": jnp.bfloat16}[fetch_dtype]
         self.model = RAFTStereo(self.effective_config)
         self._compiled: Dict[Tuple[int, int], any] = {}
 
@@ -101,6 +117,7 @@ class InferenceRunner:
                 # dicts iterate in insertion order -> drop the oldest
                 self._compiled.pop(next(iter(self._compiled)))
             model, iters = self.model, self.iters
+            fetch_dtype = self.fetch_dtype
 
             @jax.jit
             def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
@@ -108,6 +125,8 @@ class InferenceRunner:
                 img2 = images2.astype(jnp.float32)
                 _, flow_up = model.apply(variables, img1, img2, iters=iters,
                                          test_mode=True)
+                if fetch_dtype is not None:
+                    flow_up = flow_up.astype(fetch_dtype)
                 return flow_up
 
             self._compiled[key] = fwd
@@ -144,6 +163,8 @@ class InferenceRunner:
         flow_padded = np.asarray(fwd(self.variables, jnp.asarray(p1[None]),
                                      jnp.asarray(p2[None])))[0]
         flow = padder.unpad(flow_padded[None])[0]  # pure NumPy slicing
+        if flow.dtype != np.float32:               # half-precision fetch
+            flow = flow.astype(np.float32)
         elapsed = time.perf_counter() - t0
         return np.ascontiguousarray(flow), elapsed
 
@@ -176,6 +197,8 @@ class InferenceRunner:
         flows_padded = np.asarray(fwd(self.variables, jnp.asarray(p1),
                                       jnp.asarray(p2)))
         flows = padder.unpad(flows_padded)
+        if flows.dtype != np.float32:              # half-precision fetch
+            flows = flows.astype(np.float32)
         elapsed = time.perf_counter() - t0
         return np.ascontiguousarray(flows), elapsed
 

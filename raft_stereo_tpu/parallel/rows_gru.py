@@ -81,7 +81,14 @@ def default_gru_halo(cfg: RaftStereoConfig) -> int:
     ≤5/≤2 at their own resolution against halves of the halo.  16 covers it
     with margin.  ``slow_fast_gru`` with 3 GRU levels runs the coarse GRU
     three times per iteration (core/raft_stereo.py:124-130 analog), tripling
-    the coarse-level shrink against a quarter of the halo → 32."""
+    the coarse-level shrink against a quarter of the halo → 32.
+    ``slow_fast_gru`` with 2 GRU levels (the realtime preset) doubles only
+    the MID-level update: 2 updates × ≤5 rows at level-1 resolution = ≤10
+    mid rows against halo/2 = 8 fine rows = 16 mid... — conservatively, the
+    mid-level window carries halo/2 = 8 mid rows ≥ the 2×≤2-row GRU-conv
+    shrink plus the one ≤5-row encoder pass (run once at the fine level
+    only), so 16 still covers it; ``test_rows_gru_slow_fast_two_level``
+    pins this empirically at halo=16."""
     if cfg.slow_fast_gru and cfg.n_gru_layers == 3:
         return 32
     return 16
@@ -110,7 +117,18 @@ def _restricted_rows_interp(h_src: int, h_dst: int, starts_src, starts_dst,
     out = np.zeros((n, len_dst, len_src), np.float32)
     for i in range(n):
         block = mg[starts_dst[i]:starts_dst[i] + len_dst]      # (len_dst, h_src)
-        cols = np.clip(np.arange(h_src) - starts_src[i], 0, len_src - 1)
+        rel = np.arange(h_src) - starts_src[i]
+        cols = np.clip(rel, 0, len_src - 1)
+        # the window-edge clamp is sound only while align-corners sources
+        # fall at most 1 row outside the window (module docstring); if
+        # _interp_matrix semantics ever change (e.g. half-pixel centers),
+        # fail loudly at trace time instead of silently misplacing weight
+        carries = np.abs(block).sum(axis=0) > 0                # (h_src,)
+        clamp_dist = np.abs(rel - cols)
+        assert int(clamp_dist[carries].max(initial=0)) <= 1, (
+            "rows_gru: interp source row falls more than 1 row outside its "
+            "device window — _interp_matrix semantics changed; re-derive "
+            "the halo geometry")
         acc = np.zeros((len_src, len_dst), np.float32)
         np.add.at(acc, cols, block.T)
         out[i] = acc.T

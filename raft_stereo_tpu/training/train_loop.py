@@ -124,12 +124,16 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
           log_dir: str = "runs",
           validate_fn=None,
           loader: Optional[StereoLoader] = None,
-          use_mesh: bool = True) -> TrainState:
+          use_mesh: bool = True,
+          warm_start: bool = False) -> TrainState:
     """Run the training loop; returns the final state.
 
     ``restore`` accepts a previous run's checkpoint directory (exact resume,
     optimizer state and step included) or a reference ``.pth`` (warm start,
-    like the reference's --restore_ckpt).
+    like the reference's --restore_ckpt).  ``warm_start=True`` makes an
+    orbax ``restore`` load WEIGHTS ONLY — fresh optimizer and step 0 — the
+    fine-tune lifecycle (the reference fine-tunes KITTI from the sceneflow
+    .pth the same way: weights in, schedule restarts).
     ``validate_fn(variables, model_cfg) -> dict`` runs every
     ``train_cfg.validation_frequency`` steps; ``model_cfg`` is the
     AUTHORITATIVE architecture (a checkpoint restore re-derives it, so a
@@ -201,6 +205,14 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         state = state.replace(params=variables["params"],
                               batch_stats=variables.get("batch_stats", {}))
         log.info("warm start from torch checkpoint %s", restore)
+    elif restore and warm_start:
+        # weights-only fine-tune start from one of our orbax checkpoints
+        from raft_stereo_tpu.training.checkpoint import load_weights
+        model_cfg, variables = load_weights(restore)
+        state = create_train_state(model_cfg, train_cfg, rng, init_shape)
+        state = state.replace(params=variables["params"],
+                              batch_stats=variables.get("batch_stats", {}))
+        log.info("warm start (weights only) from %s", restore)
     elif restore:
         state = create_train_state(model_cfg, train_cfg, rng, init_shape)
         model_cfg, restored = ckpt.load_checkpoint(

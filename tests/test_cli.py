@@ -214,3 +214,25 @@ def test_runner_deep_iters_bf16_corr_guard():
     fp32_cfg = RaftStereoConfig()  # no mixed precision -> nothing to guard
     assert not InferenceRunner(fp32_cfg, {},
                                iters=32).effective_config.corr_fp32
+
+
+def test_train_cli_rows_gru(tmp_path):
+    """Full-loop context parallelism from the user-facing surface: the one
+    -flag UX the reference gives DataParallel (train_stereo.py:134), here
+    ``--rows_shards 2 --rows_gru``.  Launches a real training step through
+    cli.train on a 2-device rows mesh (1 data x 1 corr x 2 rows)."""
+    from raft_stereo_tpu.cli import train as train_cli
+
+    # fine level = 192/4 = 48 rows -> slab 24 = 2*halo at halo=12
+    _make_kitti_tree(tmp_path / "KITTI", n=4, size=(192, 96))
+    state = train_cli.main([
+        "--name", "rg", "--data_root", str(tmp_path),
+        "--checkpoint_dir", str(tmp_path / "ck"),
+        "--log_dir", str(tmp_path / "runs"),
+        "--train_datasets", "kitti", "--batch_size", "1", "--num_steps", "1",
+        "--train_iters", "2", "--valid_iters", "2",
+        "--image_size", "192", "64", "--hidden_dims", "32", "32", "32",
+        "--data_parallel", "1",
+        "--rows_shards", "2", "--rows_gru", "--rows_gru_halo", "12",
+    ])
+    assert int(state.step) == 1

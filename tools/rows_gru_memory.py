@@ -73,7 +73,7 @@ def mesh_scaling(args):
     from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
 
     h, w = args.height, args.width
-    for n_rows in (1, 2, 4, 8):
+    for n_rows in args.rows:
         # fp32 on the CPU mesh: XLA's CPU backend aborts ("Invalid binary
         # instruction opcode copy", hlo_instruction.cc) compiling the bf16
         # BACKWARD of the row-sharded loop — a backend compiler bug
@@ -82,7 +82,8 @@ def mesh_scaling(args):
         # scaling ratio this measurement exists for is dtype-independent.
         model_cfg = RaftStereoConfig(
             corr_backend="alt", mixed_precision=False,
-            rows_shards=n_rows, rows_gru=n_rows > 1, rows_gru_halo=12)
+            rows_shards=n_rows, rows_gru=n_rows > 1,
+            rows_gru_halo=args.halo)
         train_cfg = TrainConfig(batch_size=1, train_iters=args.iters,
                                 image_size=(h, w), data_parallel=1)
         mesh = (make_mesh(n_data=1, n_corr=1, n_rows=n_rows,
@@ -94,14 +95,18 @@ def mesh_scaling(args):
             compiled = _train_step_compiled(model_cfg, train_cfg, mesh,
                                             (h, w))
         ma = compiled.memory_analysis()
+        total_gib = (ma.temp_size_in_bytes
+                     + ma.argument_size_in_bytes) / 2**30
         print(json.dumps({
             "metric": "rows_gru_mesh_memory",
-            "n_rows": n_rows,
+            "n_rows": n_rows, "halo": args.halo,
             "image": f"{h}x{w}", "iters": args.iters,
             "per_device_temp_mib": round(ma.temp_size_in_bytes / 2**20, 1),
             "per_device_args_mib": round(
                 ma.argument_size_in_bytes / 2**20, 1),
-            "unit": "MiB/device (XLA buffer assignment, CPU backend)",
+            "per_device_total_gib": round(total_gib, 3),
+            "fits_16gib_chip": bool(total_gib < 15.75),
+            "unit": "MiB/device (XLA buffer assignment, CPU backend, fp32)",
         }), flush=True)
 
 
@@ -155,6 +160,12 @@ def main():
     p.add_argument("--height", type=int, default=768)
     p.add_argument("--width", type=int, default=256)
     p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--rows", type=int, nargs="+", default=[1, 2, 4, 8],
+                   help="rows-shard counts for --mesh-scaling (full "
+                        "Middlebury-F geometry: --height 1984 works for "
+                        "rows<=4; rows=8 needs H%%128==0, e.g. 2048)")
+    p.add_argument("--halo", type=int, default=12,
+                   help="rows_gru fine-level halo rows")
     p.add_argument("--banded", action="store_true",
                    help="chip-wall with the banded (streaming) encoder — "
                         "the single-chip alternative to row sharding")
