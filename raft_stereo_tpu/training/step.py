@@ -35,8 +35,9 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
 
     ``batch``: image1/image2 (B,H,W,3) uint8 or float32 0..255 (the loader
     ships uint8 to quarter the host->device transfer; the model normalizes
-    either on device), flow (B,H,W) x-flow (= -disparity), valid (B,H,W)
-    in {0,1}.
+    either on device), flow (B,H,W) x-flow (= -disparity) in float32 or
+    float16 (TrainConfig.compact_upload halves the flow upload; cast back
+    to f32 here on device), valid (B,H,W) in {0,1}, any dtype.
     ``jitter``: on-device photometric augmentation params
     (TrainConfig.device_photometric); the PRNG key is folded from
     ``(jitter_seed, state.step)`` so the factor stream is deterministic
@@ -52,11 +53,15 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
                                        key, jitter)
         batch = dict(batch, image1=img1, image2=img2)
 
+    # compact uploads arrive fp16/uint8; all loss math runs f32 on device
+    flow_gt = batch["flow"].astype(jnp.float32)
+    valid_gt = batch["valid"].astype(jnp.float32)
+
     def loss_fn(params):
         preds = state.apply_fn(
             {"params": params, "batch_stats": batch_stats},
             batch["image1"], batch["image2"], iters=iters)
-        loss, metrics = sequence_loss(preds, batch["flow"], batch["valid"],
+        loss, metrics = sequence_loss(preds, flow_gt, valid_gt,
                                       loss_gamma=loss_gamma, max_flow=max_flow)
         return loss, metrics
 

@@ -308,8 +308,20 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     # Host->device upload (or global shard assembly) runs on a prefetch
     # thread, ahead of the step dispatch — the synchronous per-step upload
     # is otherwise serial with compute (see _DevicePrefetcher).
-    put = ((lambda b: shard_batch(b, mesh)) if mesh is not None
-           else jax.device_put)
+    upload = ((lambda b: shard_batch(b, mesh)) if mesh is not None
+              else jax.device_put)
+    if train_cfg.compact_upload:
+        def put(b):
+            # halve the GT bytes on the wire (config.compact_upload):
+            # fp16 flow + uint8 valid, cast back to f32 in train_step
+            c = dict(b)
+            if c["flow"].dtype == np.float32:
+                c["flow"] = c["flow"].astype(np.float16)
+            if c["valid"].dtype == np.float32:
+                c["valid"] = (c["valid"] > 0.5).astype(np.uint8)
+            return upload(c)
+    else:
+        put = upload
     batches = _DevicePrefetcher(iter(loader), put)
     try:
         while True:
