@@ -56,7 +56,8 @@ def disparity_field(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
 
 def layered_scene(rng: np.random.Generator, h: int, w: int,
                   d_max: float | None = None, n_layers: int | None = None,
-                  p_textureless: float = 0.25):
+                  p_textureless: float = 0.25,
+                  d_ceiling: float | None = None):
     """Geometrically exact layered stereo scene in the BENCHMARK disparity
     regime — the round-5 hardening of ``disparity_field``/``warp_right``.
 
@@ -98,7 +99,8 @@ def layered_scene(rng: np.random.Generator, h: int, w: int,
         d_max = min(190.0, 0.35 * w)
     if n_layers is None:
         n_layers = int(rng.integers(4, 9))
-    d_ceiling = float(rng.uniform(0.35, 1.0)) * d_max
+    if d_ceiling is None:
+        d_ceiling = float(rng.uniform(0.35, 1.0)) * d_max
     # margin absorbs plane slopes (<= 0.06*d_ceiling each of b, c)
     w_ext = w + int(np.ceil(1.15 * d_ceiling)) + 2
     yy = np.arange(h, dtype=np.float32)[:, None] / h          # (H,1)

@@ -306,3 +306,28 @@ def test_legacy_convzr_checkpoint_migrates(tmp_path):
                                 jax.tree_util.tree_leaves_with_path(merged)):
         assert pa == pb
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_compact_upload_batch_dtypes(rng):
+    """TrainConfig.compact_upload ships fp16 flow + uint8 valid on the
+    wire; the step casts back to f32 on device.  Lock that (a) the step
+    accepts the compact dtypes, (b) the result differs from the f32-GT
+    step only by fp16 GT rounding (worst ulp 0.125 px below 256 px),
+    (c) the compact path is deterministic."""
+    mcfg = RaftStereoConfig(n_gru_layers=2, hidden_dims=(64, 64))
+    tcfg = TrainConfig(train_iters=2, num_steps=100)
+    state = create_train_state(mcfg, tcfg, jax.random.PRNGKey(0),
+                               image_shape=(1, 32, 64, 3))
+    step_fn = make_train_step(tcfg, donate=False)
+    batch = _tiny_batch(rng, b=2)
+    batch["valid"] = jnp.asarray(
+        np.random.default_rng(0).random((2, 32, 64)) > 0.3, jnp.float32)
+    compact = dict(batch,
+                   flow=jnp.asarray(np.asarray(batch["flow"]), jnp.float16),
+                   valid=jnp.asarray(np.asarray(batch["valid"]), jnp.uint8))
+    _, m32 = step_fn(state, batch)
+    _, m16 = step_fn(state, compact)
+    _, m16b = step_fn(state, {k: jnp.array(v) for k, v in compact.items()})
+    assert float(m16["loss"]) == float(m16b["loss"])  # deterministic
+    assert abs(float(m16["loss"]) - float(m32["loss"])) < 1e-2

@@ -15,10 +15,13 @@ This tool measures it, on the chip, end to end:
       warped-stereo scenes, fp32 correlation) so predictions track ground
       truth and numeric drift is measured in a FUNCTIONING network rather
       than amplified through an untrained GRU;
-* scenes — synthetic warped-stereo at 384x1248 (KITTI-class) with ground
-  truth disparity scaled into three bands with maxima ~48 / ~96 / ~192 px,
-  spanning the real evaluation range (the reference's KITTI protocol
-  clips at 192 px -- evaluate_stereo.py:133-135);
+* scenes — HARD layered stereo at 384x1248 (KITTI-class): true
+  occlusions, depth discontinuities, textureless patches
+  (tests/golden_data.py layered_scene), with per-band disparity ceilings
+  pinned at exactly 48 / 96 / 192 px, spanning the real evaluation range
+  (the reference's KITTI protocol clips at 192 px --
+  evaluate_stereo.py:133-135).  With the --ckpt weights trained on the
+  same distribution (round 5), every band is in-distribution;
 * backends from IDENTICAL weights:
   bf16-alt (shipped), corr_fp32 alt (the knob), fp32 reg (reference-exact
   numerics).
@@ -43,7 +46,9 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 sys.path.insert(0, _REPO)
 
 H, W = 384, 1248                  # KITTI-class, /32-aligned
-BANDS = {"d<=48": 4.0, "d<=96": 8.0, "d<=192": 16.0}  # disparity_field x scale
+# per-band disparity ceiling (round 5: HARD layered scenes with true
+# occlusions at exactly this ceiling, not a scaled smooth ramp)
+BANDS = {"d<=48": 48.0, "d<=96": 96.0, "d<=192": 192.0}
 N_PER_BAND = 2
 ITERS = (7, 32)                   # realtime demo depth, accuracy depth
 TRAIN_STEPS = 300
@@ -51,16 +56,15 @@ TRAIN_HW = (320, 704)
 
 
 def make_band_scenes():
-    from golden_data import disparity_field, textured_image, warp_right
+    from golden_data import layered_scene
 
     rng = np.random.default_rng(11)
     scenes = {}
-    for name, scale in BANDS.items():
+    for name, ceiling in BANDS.items():
         rows = []
         for _ in range(N_PER_BAND):
-            left = textured_image(rng, H, W)
-            disp = disparity_field(rng, H, W) * scale
-            right = warp_right(left, disp)
+            left, right, disp, _occ = layered_scene(
+                rng, H, W, d_max=ceiling, d_ceiling=ceiling)
             rows.append((left.astype(np.float32),
                          right.astype(np.float32), disp))
         scenes[name] = rows

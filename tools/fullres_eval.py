@@ -18,7 +18,10 @@ FULLRES_GATES_r03.json); the measured bf16 consequence at 32 iters is
 +0.04 px EPE (BF16_DRIFT_r03.json) — the right trade at 5.7 MP, recorded in
 the artifact.
 
-Writes FULLRES_EVAL_r04.json: EPE/D1 from the real validator, per-image
+Round 5: the tree is HARD layered scenes (true occlusions, textureless
+surfaces) with disparities to ~560 px — the trainingF-scale analog of the
+training corpus's 190/960 disparity-to-width ratio (real trainingF GT runs
+to ~800 px at Jadeplant).  Writes FULLRES_EVAL_r05.json: EPE/D1 from the real validator, per-image
 seconds (the runner's honest fetch-stop clock), and the XLA-compiled peak
 HBM of the forward at this size.
 """
@@ -37,13 +40,13 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 sys.path.insert(0, _REPO)
 
 HW = (1984, 2880)       # Jadeplant-class trainingF frames, /32-aligned
+D_MAX = 560.0           # training corpus disparity/width ratio at F scale
 N_SCENES = 2
 ITERS = 32
 
 
 def build_tree(root: str) -> None:
     import golden_data as gd
-    from trained_eval import fast_pair
 
     marker = os.path.join(root, ".complete")
     if os.path.exists(marker):
@@ -52,13 +55,13 @@ def build_tree(root: str) -> None:
     shutil.rmtree(os.path.join(root, "MiddEval3"),
                   ignore_errors=True)  # partial build from an interrupt
     t0 = time.time()
-    orig = gd._pair
-    gd._pair = lambda r, h, w: fast_pair(r, h, w)
+    orig = gd.hard_pair
+    gd.hard_pair = lambda r, h, w: orig(r, h, w, d_max=D_MAX)
     try:
         gd.make_middlebury(root, np.random.default_rng(4), n=N_SCENES,
-                           hw=HW, split="F")
+                           hw=HW, split="F", hard=True)
     finally:
-        gd._pair = orig
+        gd.hard_pair = orig
     open(marker, "w").write("ok")
     print(f"[tree] {N_SCENES} scenes at {HW[0]}x{HW[1]} in "
           f"{time.time() - t0:.0f}s", flush=True)
@@ -78,7 +81,7 @@ def main():
     from raft_stereo_tpu.eval.validate import validate_middlebury
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 
-    root = "/tmp/fullres_eval_r04/Middlebury"
+    root = "/tmp/fullres_eval_r05/Middlebury"
     os.makedirs(root, exist_ok=True)
     build_tree(root)
 
@@ -89,12 +92,12 @@ def main():
     import dataclasses
 
     from raft_stereo_tpu.training.checkpoint import load_weights
-    trained_ckpt = "/tmp/trained_eval_r04/ckpt/r04"
+    trained_ckpt = "/tmp/trained_eval_r05/ckpt/r05"
     if os.path.isdir(trained_ckpt):
         ckpt_cfg, variables = load_weights(trained_ckpt)
         cfg = dataclasses.replace(ckpt_cfg, corr_backend="alt",
                                   banded_encoder=True, mixed_precision=True)
-        weights_note = "TRAINED (tools/trained_eval.py round-4 checkpoint)"
+        weights_note = "TRAINED (tools/trained_eval.py round-5 checkpoint (hard-scene trained))"
         model = RAFTStereo(cfg)
     else:
         cfg = RaftStereoConfig(corr_backend="alt", banded_encoder=True,
@@ -105,7 +108,7 @@ def main():
                                                  test_mode=True)
                             )(jax.random.PRNGKey(0))
         weights_note = ("random-init (trained product numbers live in "
-                        "TRAINED_EVAL_r04.json)")
+                        "TRAINED_EVAL_r05.json)")
 
     # Compiled peak HBM of the forward at the exact eval shape (the runtime
     # exposes no live memory stats — bench_fullres.py) .
@@ -128,7 +131,7 @@ def main():
     rec = {
         "metric": "fullres_product_eval_middleburyF",
         "value": round(res["middleburyF-epe"], 3),
-        "unit": "px EPE (validate_middlebury, synthetic trainingF tree)",
+        "unit": "px EPE (validate_middlebury, HARD synthetic trainingF tree)",
         "d1_pct": round(res["middleburyF-d1"], 2),
         "size": f"{HW[0]}x{HW[1]}",
         "iters": ITERS,
@@ -145,7 +148,7 @@ def main():
         "device": str(jax.devices()[0].device_kind),
     }
     print(json.dumps(rec))
-    with open(os.path.join(_REPO, "FULLRES_EVAL_r04.json"), "w") as f:
+    with open(os.path.join(_REPO, "FULLRES_EVAL_r05.json"), "w") as f:
         f.write(json.dumps(rec) + "\n")
 
 
