@@ -173,6 +173,14 @@ def main():
                    default=["512x736", "992x1440", "1984x2880"])
     args = p.parse_args()
     if args.mesh_scaling:
+        # hermetic CPU virtual mesh — env vars alone do NOT work here:
+        # sitecustomize imports jax and registers the remote-TPU plugin
+        # before any user code runs (tests/_hermetic.py)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests"))
+        from _hermetic import force_cpu
+        force_cpu(max(8, max(args.rows)))
         mesh_scaling(args)
     if args.chip_wall:
         chip_wall(args)
