@@ -184,13 +184,14 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             ctx.enter_context(rows_sharding(mesh, axis=ROWS_AXIS))
         return _train_impl(model_cfg, train_cfg, name, data_root,
                            checkpoint_dir, restore, log_dir, validate_fn,
-                           loader, mesh)
+                           loader, mesh, warm_start)
 
 
 def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                 name: str, data_root: str, checkpoint_dir: str,
                 restore: Optional[str], log_dir: str, validate_fn,
-                loader: Optional[StereoLoader], mesh) -> TrainState:
+                loader: Optional[StereoLoader], mesh,
+                warm_start: bool = False) -> TrainState:
     h, w = train_cfg.image_size
     init_shape = (1, h, w, 3)
     rng = jax.random.PRNGKey(train_cfg.seed)
