@@ -357,21 +357,26 @@ def rows_sharded_gru_loop(cfg: RaftStereoConfig, dtype, update_params,
                                    length=iters)
         return flow_ups
 
-    # Pin the executor's inputs H-UNSHARDED in the surrounding auto-sharded
-    # world.  Without this, the shard_map's row-sharded input demand
-    # propagates backward through the encoders' cheap ≤1/2-res tail, whose
-    # conv tensors then end up sharded over (batch x rows) simultaneously —
-    # the exact regime where XLA's SPMD conv-KERNEL-gradient partitioning
-    # double-counts (reproduced and documented for the trunk executor,
-    # parallel/rows_sharded.py).  The reshard to row shards happens at the
-    # shard_map boundary instead; the O(H) full-resolution segment and the
-    # scan carries stay sharded, which is where the memory lives.
+    # Pin the executor's inputs' H sharding in the surrounding auto-sharded
+    # world.  Pure rows mesh (no data/corr axis — the full-resolution
+    # -training regime): keep H SHARDED over the rows axis so the encoders'
+    # ≤1/2-res tail stays row-sharded end to end — measured on the 8-dev
+    # virtual mesh at 2048x2880, an UNSHARDED pin left ~49 GiB/device of
+    # replicated tail backward stores (ROWSGRU_MEMORY_r05.json iters-6
+    # probe), dwarfing the sharded loop.  With a data axis > 1 the pin
+    # flips to H-UNSHARDED: tail convs sharded over (batch x rows)
+    # simultaneously hit XLA's SPMD conv-KERNEL-gradient double-count
+    # (reproduced and documented for the trunk executor,
+    # parallel/rows_sharded.py); there the reshard happens at the
+    # shard_map boundary and only the full-res segment + scan carries
+    # stay sharded.
     from jax.sharding import NamedSharding
     unc = P.UNCONSTRAINED
+    h_spec = axis if mesh.devices.size == n else None
 
     def _pin(x):
-        spec = (P(unc, None, unc, unc) if x.ndim == 4
-                else P(unc, None, unc))
+        spec = (P(unc, h_spec, unc, unc) if x.ndim == 4
+                else P(unc, h_spec, unc))
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, spec))
 

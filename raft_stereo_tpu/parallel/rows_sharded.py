@@ -151,18 +151,20 @@ def rows_sharded_trunk_apply(trunk_params, batch_stats, x, norm_fn, dtype,
         return u[:, crop], v[:, crop]
 
     u, v = segment_sharded(trunk_params, batch_stats, x)
-    # Re-enter the auto-sharded world with H explicitly UNSHARDED (batch
-    # and trailing dims left to propagation).  Without this constraint XLA
-    # may keep the tail's tensors sharded over (batch x rows)
-    # simultaneously, and its SPMD conv-KERNEL-gradient partitioning then
-    # double-counts: every tail conv kernel grad came out exactly
+    # Re-enter the auto-sharded world.  H stays SHARDED over the rows axis
+    # when no other mesh axis is in play (pure context parallelism — the
+    # full-resolution-training regime, where the ≤1/2-res tail's backward
+    # stores are still O(H) gigabytes); but with a data axis > 1 H is
+    # pinned UNSHARDED: XLA's SPMD conv-KERNEL-gradient partitioning
+    # double-counts when a conv is sharded over (batch x rows)
+    # simultaneously — every tail conv kernel grad came out exactly
     # n_data x with bias/norm grads correct (reproduced on jax 0.9 CPU
-    # meshes (2,2)/(2,4); clean on (1,2) and (2,1)).  The memory win is
-    # unaffected — the full-RESOLUTION segment stays sharded; the tail is
-    # <=1/2-res.
+    # meshes (2,2)/(2,4); clean on (1,2) and (2,1)).
     from jax.sharding import NamedSharding
     unconstr = P.UNCONSTRAINED
-    spec = NamedSharding(mesh, P(unconstr, None, unconstr, unconstr))
+    n_other = mesh.devices.size // mesh.shape[axis]
+    h_spec = axis if n_other == 1 else None
+    spec = NamedSharding(mesh, P(unconstr, h_spec, unconstr, unconstr))
     u = jax.lax.with_sharding_constraint(u, spec)
     v = jax.lax.with_sharding_constraint(v, spec)
     # <=1/2-res tail on the reassembled tensors (instance norms here see
