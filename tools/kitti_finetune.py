@@ -86,6 +86,12 @@ def main():
                     help="tiny CPU pre-flight (fresh tiny weights, 3 steps)")
     args = ap.parse_args()
 
+    if args.smoke:
+        # hermetic CPU pre-flight — env vars alone cannot force CPU here
+        # (sitecustomize registers the remote-TPU plugin first)
+        from _hermetic import force_cpu
+        force_cpu(1)
+
     import jax
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
