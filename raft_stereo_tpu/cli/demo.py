@@ -45,7 +45,8 @@ def run_demo(args) -> int:
 
     cfg, variables = common.load_any_checkpoint(
         args.restore_ckpt, **common.arch_overrides(args))
-    runner = InferenceRunner(cfg, variables, iters=args.valid_iters)
+    runner = InferenceRunner(cfg, variables, iters=args.valid_iters,
+                             fetch_dtype=args.fetch_dtype)
 
     out_dir = args.output_directory
     os.makedirs(out_dir, exist_ok=True)
@@ -82,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output_directory", default="demo_output")
     p.add_argument("--save_numpy", action="store_true")
     p.add_argument("--valid_iters", type=int, default=32)
+    p.add_argument("--fetch_dtype", default=None,
+                   choices=["fp16", "bf16"],
+                   help="half-precision device->host disparity fetch "
+                        "(halves the down-leg bytes; results stay f32 — "
+                        "eval/runner.py; fp16 ulp <= 0.125 px at |d|<256)")
     common.add_arch_overrides(p)
     return p
 

@@ -28,7 +28,8 @@ def run_eval(args) -> dict:
     # backend (evaluate_stereo.py:227-230)
     cfg, variables = common.load_any_checkpoint(args.restore_ckpt, **overrides)
     log.info("model config: %s", cfg.to_dict())
-    runner = InferenceRunner(cfg, variables, iters=args.valid_iters)
+    runner = InferenceRunner(cfg, variables, iters=args.valid_iters,
+                         fetch_dtype=args.fetch_dtype)
 
     root = args.data_root
     if args.dataset == "eth3d":
@@ -56,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--valid_iters", type=int, default=32,
                    help="GRU iterations (reference: --valid_iters)")
+    p.add_argument("--fetch_dtype", default=None,
+                   choices=["fp16", "bf16"],
+                   help="half-precision device->host disparity fetch "
+                        "(halves the down-leg bytes; results stay f32 — "
+                        "eval/runner.py; fp16 ulp <= 0.125 px at |d|<256)")
     p.add_argument("--max_images", type=int, default=None,
                    help="evaluate only the first N images (smoke runs)")
     p.add_argument("--json", action="store_true",
