@@ -395,8 +395,8 @@ def orchestrate() -> None:
         raise SystemExit(f"phase C failed rc={rc_c}; see {log_path}")
     import shutil
     demo_png = os.path.join(WORK, "demo", "0006-disparity.png")
-    if os.path.exists(demo_png):
-        shutil.copy(demo_png,
+    if os.path.exists(demo_png) and not SMOKE:  # smoke must not clobber
+        shutil.copy(demo_png,                   # the real round's PNG
                     os.path.join(_REPO, "docs", f"demo_trained_{NAME}.png"))
 
     # ---- assemble the artifact
