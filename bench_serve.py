@@ -1,0 +1,186 @@
+"""Serving-path throughput/latency: the micro-batching service under load.
+
+bench_product.py measures the per-image and hand-batched product paths with
+ONE caller; this bench drives the serving subsystem (raft_stereo_tpu/serving)
+the way traffic actually arrives — an open-loop generator offering requests
+at a fixed rate, independent of service progress — across several offered
+loads and batch settings, against the single-caller solo baseline measured
+in the same run.  Open-loop matters: a closed loop (submit, wait, repeat)
+self-throttles exactly when the service is slow and hides queueing collapse;
+open-loop exposes it, and the bounded queue's typed shedding is part of the
+result, not an error.
+
+Per setting: completed/s, p50/p95/p99 end-to-end latency, the queue-wait
+share, mean batch occupancy, and shed counts — all read from the service's
+own metrics layer (serving/metrics.py), which is the point: the
+observability surface is what gets benchmarked.
+
+Prints one JSON line (bench.py contract) and writes BENCH_SERVE_r06.json.
+On a CPU fallback the model/geometry shrink so the bench completes in
+minutes; on an accelerator it runs the realtime config at KITTI resolution.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+OUT = "BENCH_SERVE_r06.json"
+
+
+def build_model(on_cpu: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    if on_cpu:  # CPU fallback: keep the bench minutes-scale
+        cfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
+                               corr_backend="reg")
+        hw, iters = (128, 192), 2
+    else:
+        cfg = RaftStereoConfig.realtime()
+        hw, iters = (375, 1242), 7   # bench_product.py's realtime protocol
+    model = RAFTStereo(cfg)
+    img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img_s, img_s, iters=1,
+                                             test_mode=True)
+                        )(jax.random.PRNGKey(0))
+    return cfg, variables, hw, iters
+
+
+def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
+                     n_requests: int, max_batch: int, batch_mode: str,
+                     max_queue: int, rng: np.random.Generator) -> dict:
+    """One open-loop run: submit at ``rate_hz`` (exponential inter-arrival
+    times — Poisson traffic), wait for completion, report from metrics."""
+    from raft_stereo_tpu.serving import Overloaded, ServeConfig, StereoService
+
+    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+             for _ in range(4)]
+    rights = [np.roll(l, -5, axis=1) for l in lefts]
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=max_batch, max_wait_ms=8.0, max_queue=max_queue,
+        batch_mode=batch_mode, iters=iters))
+    try:
+        # Compile + warm: solo first (batch-1 executable), then concurrent
+        # bursts so stack mode's power-of-two batch executables compile
+        # before the measured window, as the solo warmup absorbs XLA
+        # compilation in the FPS protocol (profiling.FpsProtocol).
+        svc.infer(lefts[0], rights[0], timeout=600)
+        for _ in range(3):
+            warm = [svc.submit(lefts[i % 4], rights[i % 4])
+                    for i in range(max_batch)]
+            for f in warm:
+                f.result(timeout=600)
+        gaps = rng.exponential(1.0 / rate_hz, n_requests)
+        futures, shed = [], 0
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            target = t0 + float(gaps[:i + 1].sum())
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(svc.submit(lefts[i % 4], rights[i % 4]))
+            except Overloaded:
+                shed += 1
+        results = [f.result(timeout=600) for f in futures]
+        wall = time.perf_counter() - t0
+        # Per-run stats come from the ServeResults — each carries the
+        # metrics layer's stage decomposition (queue wait / device / fetch,
+        # micro-batch occupancy) for exactly the measured window, while the
+        # service-lifetime histograms also include the warmup above.
+        total = np.array([r.total_s for r in results])
+        qwait = np.array([r.queue_wait_s for r in results])
+        occ = np.array([r.batch_size for r in results])
+        pct = lambda a, q: round(float(np.percentile(a, q)) * 1e3, 1)  # noqa: E731
+        return {
+            "offered_hz": round(rate_hz, 2),
+            "max_batch": max_batch,
+            "batch_mode": batch_mode,
+            "offered": n_requests,
+            "completed": len(results),
+            "shed_queue_full": shed,
+            "throughput_hz": round(len(results) / wall, 2),
+            "latency_ms": {f"p{q}": pct(total, q) for q in (50, 95, 99)},
+            "queue_wait_ms": {
+                "p50": pct(qwait, 50), "p95": pct(qwait, 95),
+                "mean": round(float(qwait.mean()) * 1e3, 1)},
+            "device_ms_mean": round(float(np.mean(
+                [r.device_s for r in results])) * 1e3, 1),
+            "fetch_ms_mean": round(float(np.mean(
+                [r.fetch_s for r in results])) * 1e3, 1),
+            "batch_occupancy_mean": round(float(occ.mean()), 2),
+            "batches": svc.metrics.batches.value,
+        }
+    finally:
+        svc.close()
+
+
+def main():
+    import jax
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg, variables, hw, iters = build_model(on_cpu)
+    rng = np.random.default_rng(0)
+
+    # --- solo baseline: the single-caller per-image product path
+    runner = InferenceRunner(cfg, variables, iters=iters)
+    left = rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+    right = np.roll(left, -5, axis=1)
+    runner(left, right)  # compile
+    solo = [runner(left, right)[1] for _ in range(7)]
+    solo_s = float(np.median(solo))
+    solo_hz = 1.0 / solo_s
+
+    # --- offered loads vs batch settings.  Loads are relative to the solo
+    # rate: 0.7x (below capacity — latency should sit near solo), and 1.5x
+    # (beyond a single caller — only batching keeps up, shedding appears
+    # once the bounded queue saturates).
+    n_req = 48 if on_cpu else 120
+    settings = [
+        dict(max_batch=1, batch_mode="chain"),   # no batching: the control
+        dict(max_batch=4, batch_mode="chain"),
+        dict(max_batch=4, batch_mode="stack"),
+    ]
+    runs = []
+    for s in settings:
+        for mult in (0.7, 1.5):
+            runs.append(offered_load_run(
+                cfg, variables, hw, iters, rate_hz=mult * solo_hz,
+                n_requests=n_req, max_queue=16, rng=rng, **s))
+            print(json.dumps(runs[-1]), flush=True)
+
+    best = max(runs, key=lambda r: r["throughput_hz"])
+    rec = {
+        "metric": "serve_throughput_hz",
+        "value": best["throughput_hz"],
+        "unit": f"requests/s (serving path, {hw[0]}x{hw[1]}, iters={iters})",
+        "platform": jax.devices()[0].platform,
+        "solo_runner_hz": round(solo_hz, 2),
+        "best_vs_solo": round(best["throughput_hz"] / solo_hz, 3),
+        "best_setting": {k: best[k] for k in
+                         ("max_batch", "batch_mode", "offered_hz")},
+        "runs": runs,
+    }
+    print(json.dumps(rec))
+    with open(os.path.join(_REPO, OUT), "w") as f:
+        f.write(json.dumps(rec, indent=1) + "\n")
+
+
+if __name__ == "__main__":
+    main()

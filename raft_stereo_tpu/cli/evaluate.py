@@ -29,7 +29,7 @@ def run_eval(args) -> dict:
     cfg, variables = common.load_any_checkpoint(args.restore_ckpt, **overrides)
     log.info("model config: %s", cfg.to_dict())
     runner = InferenceRunner(cfg, variables, iters=args.valid_iters,
-                         fetch_dtype=args.fetch_dtype)
+                             fetch_dtype=args.fetch_dtype)
 
     root = args.data_root
     if args.dataset == "eth3d":

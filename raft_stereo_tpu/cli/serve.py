@@ -1,0 +1,133 @@
+"""Stereo-depth serving CLI: a localhost HTTP API over the micro-batching
+service (serving/).
+
+    raft-serve --restore_ckpt models/raftstereo-realtime.pth \\
+        --port 8551 --max_batch 8 --max_wait_ms 5
+
+    # one request: left|right side-by-side PNG in, 16-bit disparity PNG out
+    curl -s -X POST --data-binary @pair.png -H 'Content-Type: image/png' \\
+        'http://127.0.0.1:8551/v1/disparity?format=png' > disp.png
+    curl -s http://127.0.0.1:8551/metrics
+
+SIGTERM/SIGINT drain gracefully: stop admitting (new requests get 503),
+finish queued + in-flight batches, then exit — the serving mirror of the
+train loop's preemption checkpoint (training/train_loop.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from raft_stereo_tpu.cli import common
+
+log = logging.getLogger(__name__)
+
+
+def build_service(args):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = common.load_any_checkpoint(
+        args.restore_ckpt, **common.arch_overrides(args))
+    serve_cfg = ServeConfig(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue, batch_mode=args.batch_mode,
+        data_parallel=args.data_parallel, iters=args.valid_iters,
+        shape_bucket=args.shape_bucket,
+        fetch_dtype=args.fetch_dtype,
+        default_deadline_ms=args.deadline_ms)
+    return StereoService(cfg, variables, serve_cfg)
+
+
+def run_serve(args) -> int:
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    service = build_service(args)
+    server = StereoHTTPServer(service, host=args.host, port=args.port)
+    stop = threading.Event()
+    forced = threading.Event()
+
+    def _graceful(signum, frame):
+        if stop.is_set():
+            forced.set()  # second signal: skip the drain, hard-close
+            raise KeyboardInterrupt(f"second signal {signum}: force quit")
+        log.warning("signal %d: draining (refusing new work, finishing "
+                    "%d queued requests; send again to force-quit)",
+                    signum, service.batcher.depth)
+        stop.set()
+        # shutdown() unblocks serve_forever below; drain happens after.
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _graceful)
+
+    log.info("serving on %s (max_batch=%d, max_wait=%.1f ms, queue<=%d, "
+             "%d device worker(s), mode=%s)", server.url,
+             service.serve_cfg.max_batch, service.serve_cfg.max_wait_ms,
+             service.serve_cfg.max_queue, len(service.devices),
+             service.serve_cfg.batch_mode)
+    try:
+        server.serve_forever()
+    finally:
+        if forced.is_set():
+            log.warning("force quit: dropping %d queued requests",
+                        service.batcher.depth)
+            service.close()
+        else:
+            drained = service.drain(timeout=args.drain_timeout_s)
+            log.info("drain %s; final metrics:\n%s",
+                     "complete" if drained else
+                     f"timed out after {args.drain_timeout_s:.0f}s",
+                     service.metrics.render_text())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", required=True,
+                   help=".pth or orbax checkpoint directory")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8551)
+    p.add_argument("--valid_iters", type=int, default=32,
+                   help="GRU iterations per request")
+    p.add_argument("--max_batch", type=int, default=8,
+                   help="flush a shape bucket at this many requests")
+    p.add_argument("--max_wait_ms", type=float, default=5.0,
+                   help="flush a partial bucket when its oldest request "
+                        "has waited this long")
+    p.add_argument("--max_queue", type=int, default=64,
+                   help="admission bound; beyond it requests get 429")
+    p.add_argument("--batch_mode", default="chain",
+                   choices=["chain", "stack"],
+                   help="chain: N batch-1 dispatches, bitwise-equal to solo "
+                        "inference; stack: one batched dispatch per flush, "
+                        "batch-padded to the next power of two (max "
+                        "amortization, ~1e-5 numeric drift)")
+    p.add_argument("--data_parallel", type=int, default=1,
+                   help="device workers (each on its own local device)")
+    p.add_argument("--shape_bucket", type=int, default=None,
+                   help="pad to this grid instead of /32 (coarser buckets "
+                        "batch more shapes together per compile)")
+    p.add_argument("--deadline_ms", type=float, default=None,
+                   help="default per-request queue deadline (504 past it; "
+                        "X-Deadline-Ms header overrides)")
+    p.add_argument("--drain_timeout_s", type=float, default=30.0,
+                   help="max seconds to finish queued work on SIGTERM")
+    p.add_argument("--fetch_dtype", default=None,
+                   choices=["fp16", "bf16"],
+                   help="half-precision device->host disparity fetch "
+                        "(halves the down-leg bytes; results stay f32)")
+    common.add_arch_overrides(p)
+    return p
+
+
+def main(argv=None):
+    common.setup_logging()
+    return run_serve(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

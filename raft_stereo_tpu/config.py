@@ -276,11 +276,16 @@ class TrainConfig:
     # bit-equal, to host jitter (it runs after the crop and skips uint8
     # rounding between ops); the host path stays the default.
     device_photometric: bool = False
-    # Compact host->device batch upload: flow ships fp16 (worst-case GT
-    # rounding 0.125 px at |d| in [128, 256) — far below loss noise at
-    # benchmark disparities) and valid ships uint8 (lossless {0,1} mask),
-    # cast back to f32 on device inside the train step.  At the published
-    # config this cuts the per-step upload 25.8 -> 15.7 MB — behind a
+    # Compact host->device batch upload: flow ships fp16 and valid ships
+    # uint8 (lossless {0,1} mask), cast back to f32 on device.  fp16 GT
+    # rounding grows with magnitude: ulp is 0.125 px for |d| in [128, 256)
+    # but the loss mask admits |flow| up to max_flow=700 and SceneFlow GT
+    # regularly exceeds 256 px, so the honest worst case below 1024 px is
+    # 0.5 px (ulp at |d| in [512, 1024); mean rounding error ~ulp/4).
+    # Still below the loss's useful signal at those disparities — the
+    # per-pixel L1 terms there are dominated by multi-px prediction error —
+    # but 4x larger than this comment's original 0.125 px claim.  At the
+    # published config this cuts the per-step upload 25.8 -> 15.7 MB — behind a
     # ~30 MB/s tunnel that is the difference between the upload hiding
     # under device compute or spilling past it (docs/TRAIN_PROFILE.md
     # round 5).  Deterministic (fp16 rounding is a pure function); exact

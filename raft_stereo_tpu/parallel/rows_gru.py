@@ -125,10 +125,14 @@ def _restricted_rows_interp(h_src: int, h_dst: int, starts_src, starts_dst,
         # fail loudly at trace time instead of silently misplacing weight
         carries = np.abs(block).sum(axis=0) > 0                # (h_src,)
         clamp_dist = np.abs(rel - cols)
-        assert int(clamp_dist[carries].max(initial=0)) <= 1, (
-            "rows_gru: interp source row falls more than 1 row outside its "
-            "device window — _interp_matrix semantics changed; re-derive "
-            "the halo geometry")
+        # explicit raise, not `assert`: python -O strips asserts, which
+        # would silently misplace weight — the exact failure this check
+        # exists to make loud (it runs once at trace time, in NumPy)
+        if int(clamp_dist[carries].max(initial=0)) > 1:
+            raise AssertionError(
+                "rows_gru: interp source row falls more than 1 row outside "
+                "its device window — _interp_matrix semantics changed; "
+                "re-derive the halo geometry")
         acc = np.zeros((len_src, len_dst), np.float32)
         np.add.at(acc, cols, block.T)
         out[i] = acc.T

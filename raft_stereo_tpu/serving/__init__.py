@@ -1,0 +1,13 @@
+"""Inference serving subsystem: dynamic micro-batching over shape buckets,
+admission control + backpressure, device worker pool, and a plain-text
+metrics endpoint.  See docs/architecture.md §Serving."""
+
+from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
+                                             Overloaded, Request)
+from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
+from raft_stereo_tpu.serving.service import (ServeConfig, ServeResult,
+                                             StereoService)
+
+__all__ = ["DeadlineExceeded", "MicroBatcher", "Overloaded", "Request",
+           "MetricsRegistry", "ServingMetrics", "ServeConfig", "ServeResult",
+           "StereoService"]

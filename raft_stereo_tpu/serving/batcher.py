@@ -1,0 +1,211 @@
+"""Dynamic micro-batcher: bounded queue, shape-bucket grouping, timed flush.
+
+The serving front door (service.StereoService.submit) turns each stereo pair
+into a ``Request`` and offers it here.  The batcher groups compatible
+requests by their padded-shape bucket — RAFT-Stereo's fixed-iteration GRU
+loop makes per-frame device time a function of the padded shape alone
+(PAPER.md §1), so same-bucket requests batch with zero compute waste — and
+flushes a bucket when it reaches ``max_batch`` or its oldest request has
+waited ``max_wait_ms``.  Admission control is a hard bound on queued
+requests: past ``max_queue`` the submit raises the typed ``Overloaded``
+(load shedding at the door beats collapsing under a backlog), and during a
+drain new work is refused the same way while queued work finishes.
+
+Model-agnostic on purpose: ``dispatch(batch)`` is an injected callable (the
+service routes it to a device worker pool), so every queueing policy in this
+file is testable without touching JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_stereo_tpu.serving.metrics import ServingMetrics
+
+
+class Overloaded(RuntimeError):
+    """Typed load-shed rejection: the bounded queue is full, or the service
+    is draining.  Callers should back off and retry (the HTTP layer maps
+    this to 429/503 with Retry-After)."""
+
+    def __init__(self, message: str, draining: bool = False):
+        super().__init__(message)
+        self.draining = draining
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before a device picked it up."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued stereo pair.  ``payload`` is opaque to the batcher (the
+    service stores images + padder there); ``bucket`` keys compatibility."""
+
+    bucket: Tuple[int, int]
+    payload: object
+    future: Future
+    t_enqueue: float
+    deadline: Optional[float] = None  # absolute monotonic seconds
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class MicroBatcher:
+    """Bucketed request queue + flush thread.
+
+    ``dispatch(requests)`` runs on the flush thread and is expected to BLOCK
+    when the downstream worker pool is saturated — that stall is the
+    backpressure path: flushing pauses, the queue fills, and submits shed at
+    the ``max_queue`` bound instead of growing an unbounded backlog.
+    """
+
+    def __init__(self, dispatch: Callable[[List[Request]], None],
+                 max_batch: int = 8, max_wait_ms: float = 5.0,
+                 max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1:
+            raise ValueError(f"max_batch={max_batch} must be >= 1")
+        if max_queue < 1:
+            raise ValueError(f"max_queue={max_queue} must be >= 1")
+        self._dispatch = dispatch
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.max_queue = max_queue
+        self.metrics = metrics or ServingMetrics(max_batch=max_batch)
+        self._clock = clock
+        self._cond = threading.Condition()
+        # bucket -> FIFO of requests; dict preserves insertion order so the
+        # flush scan visits oldest buckets first
+        self._buckets: Dict[Tuple[int, int], List[Request]] = {}
+        self._depth = 0
+        self._draining = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="stereo-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------ admission
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return self._depth
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def submit(self, req: Request) -> None:
+        with self._cond:
+            if self._draining or self._closed:
+                self.metrics.rejected_draining.inc()
+                raise Overloaded("service is draining; not accepting work",
+                                 draining=True)
+            if self._depth >= self.max_queue:
+                self.metrics.rejected_queue_full.inc()
+                raise Overloaded(
+                    f"queue full ({self._depth}/{self.max_queue} requests "
+                    f"waiting); retry later")
+            self._buckets.setdefault(req.bucket, []).append(req)
+            self._depth += 1
+            self.metrics.admitted.inc()
+            self.metrics.queue_depth.set(self._depth)
+            self._cond.notify()
+
+    # ---------------------------------------------------------------- flush
+    def _ready_bucket(self, now: float) -> Optional[Tuple[int, int]]:
+        """Oldest bucket due for flush: full, past max_wait, or draining."""
+        for key, reqs in self._buckets.items():
+            if (len(reqs) >= self.max_batch or self._draining
+                    or now - reqs[0].t_enqueue >= self.max_wait_s):
+                return key
+        return None
+
+    def _next_due(self, now: float) -> Optional[float]:
+        """Seconds until the earliest bucket hits max_wait; None if empty."""
+        if not self._buckets:
+            return None
+        oldest = min(r[0].t_enqueue for r in self._buckets.values())
+        return max(0.0, oldest + self.max_wait_s - now)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                now = self._clock()
+                key = self._ready_bucket(now)
+                while key is None and not self._closed:
+                    self._cond.wait(timeout=self._next_due(now))
+                    now = self._clock()
+                    key = self._ready_bucket(now)
+                if key is None and self._closed:
+                    return
+                reqs = self._buckets.pop(key)
+                batch, rest = reqs[:self.max_batch], reqs[self.max_batch:]
+                if rest:  # burst bigger than max_batch: keep FIFO order
+                    # reinsertion puts the remainder last in the scan order,
+                    # but its t_enqueue keeps it due immediately
+                    self._buckets[key] = rest
+                self._depth -= len(batch)
+                self.metrics.queue_depth.set(self._depth)
+                self._cond.notify_all()  # wake drain() waiters
+            # Outside the lock: deadline triage + the (blocking) dispatch.
+            live: List[Request] = []
+            now = self._clock()
+            for r in batch:
+                if r.expired(now):
+                    self.metrics.deadline_missed.inc()
+                    r.future.set_exception(DeadlineExceeded(
+                        f"deadline passed after "
+                        f"{(now - r.t_enqueue) * 1e3:.1f} ms in queue"))
+                else:
+                    live.append(r)
+            if live:
+                self._dispatch(live)
+
+    # ---------------------------------------------------------------- drain
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting (submits raise ``Overloaded``), flush all queued
+        requests immediately (no max_wait stalling), and wait until the
+        queue is empty.  Returns False on timeout.  Dispatched batches may
+        still be running on workers — the service waits for those
+        separately."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._depth > 0:
+                remaining = (None if deadline is None
+                             else deadline - self._clock())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def close(self) -> None:
+        """Stop the flush thread.  Queued requests (drain not called, or
+        timed out) fail with ``Overloaded`` rather than hanging forever."""
+        with self._cond:
+            self._closed = True
+            self._draining = True
+            orphans = [r for reqs in self._buckets.values() for r in reqs]
+            self._buckets.clear()
+            self._depth = 0
+            self.metrics.queue_depth.set(0)
+            self._cond.notify_all()
+        for r in orphans:
+            r.future.set_exception(
+                Overloaded("service shut down before this request ran",
+                           draining=True))
+        self._thread.join(timeout=5.0)
+
+
+def drain_order(batches: Sequence[Sequence[Request]]) -> List[Request]:
+    """Flatten dispatched batches back to admission order (report helper)."""
+    return sorted((r for b in batches for r in b), key=lambda r: r.t_enqueue)

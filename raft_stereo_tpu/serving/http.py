@@ -1,0 +1,204 @@
+"""Localhost HTTP front end over ``StereoService`` — stdlib only.
+
+Endpoints:
+
+* ``POST /v1/disparity`` — one stereo pair in, one disparity map out.
+  Request body:
+    - ``Content-Type: application/x-npz`` (default): an ``np.savez``
+      archive with arrays ``left`` and ``right``, each (H, W, 3) uint8.
+    - ``Content-Type: image/png``: ONE side-by-side pair (left|right
+      concatenated along width; even width), the common packed layout for
+      stereo capture streams.
+  Optional ``X-Deadline-Ms`` header bounds the queue wait.  Response
+  (``?format=``):
+    - ``npy`` (default): raw ``.npy`` float32 positive-disparity map;
+    - ``png``: 16-bit PNG, disparity*256 (the KITTI on-disk convention —
+      data/frame_utils.write_disp_kitti reads it back losslessly to
+      1/256 px).
+  Errors map to transport codes: 429 (queue full, with ``Retry-After``),
+  503 (draining), 504 (deadline passed in queue), 400 (malformed input).
+* ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
+* ``GET /healthz`` — one JSON line: status, queue depth, device count.
+
+``ThreadingHTTPServer`` gives one Python thread per connection; the real
+concurrency limit is the service's bounded queue, which is the point —
+admission control lives in ONE place and the transport just reports it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from raft_stereo_tpu.serving.batcher import DeadlineExceeded, Overloaded
+from raft_stereo_tpu.serving.service import StereoService
+
+log = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 256 * 2 ** 20  # refuse absurd uploads before reading them
+
+
+def _decode_pair(body: bytes, content_type: str
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    if content_type.startswith("image/png"):
+        from PIL import Image
+
+        pair = np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
+        if pair.shape[1] % 2:
+            raise ValueError(
+                f"side-by-side pair width {pair.shape[1]} must be even")
+        w = pair.shape[1] // 2
+        return pair[:, :w], pair[:, w:]
+    # default: npz with left/right
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        if "left" not in z or "right" not in z:
+            raise ValueError(
+                f"npz must contain 'left' and 'right', got {sorted(z.files)}")
+        return z["left"], z["right"]
+
+
+def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
+    if fmt == "npy":
+        buf = io.BytesIO()
+        np.save(buf, disp.astype(np.float32))
+        return buf.getvalue(), "application/x-npy"
+    if fmt == "png":
+        from PIL import Image
+
+        enc = np.clip(disp * 256.0, 0, 2 ** 16 - 1).astype(np.uint16)
+        buf = io.BytesIO()
+        Image.fromarray(enc).save(buf, format="PNG")
+        return buf.getvalue(), "image/png"
+    raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+
+
+def make_handler(service: StereoService):
+    """Handler class closed over ``service`` (BaseHTTPRequestHandler is
+    instantiated per request by the server, so state rides the closure)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging, not
+            log.debug("%s " + fmt, self.client_address[0], *args)  # stderr
+
+        def _reply(self, code: int, body: bytes, content_type: str,
+                   extra_headers=()):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra_headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code: int, obj, extra_headers=()):
+            self._reply(code, (json.dumps(obj) + "\n").encode(),
+                        "application/json", extra_headers)
+
+        def do_GET(self):
+            path = urlparse(self.path).path
+            if path == "/metrics":
+                self._reply(200, service.metrics.render_text().encode(),
+                            "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                self._reply_json(200, {
+                    "status": ("draining" if service.batcher.draining
+                               else "ok"),
+                    "queue_depth": service.batcher.depth,
+                    "inflight": service.metrics.inflight.value,
+                    "devices": len(service.devices)})
+            else:
+                self._reply_json(404, {"error": f"no route {path!r}"})
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            if url.path != "/v1/disparity":
+                self._reply_json(404, {"error": f"no route {url.path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if not 0 < length <= MAX_BODY_BYTES:
+                    raise ValueError(f"Content-Length {length} out of range")
+                body = self.rfile.read(length)
+                left, right = _decode_pair(
+                    body, self.headers.get("Content-Type",
+                                           "application/x-npz"))
+                deadline_hdr = self.headers.get("X-Deadline-Ms")
+                deadline_ms: Optional[float] = (
+                    float(deadline_hdr) if deadline_hdr else None)
+                fmt = parse_qs(url.query).get("format", ["npy"])[0]
+                if fmt not in ("npy", "png"):
+                    raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+            except (ValueError, KeyError, OSError) as e:
+                self._reply_json(400, {"error": str(e)})
+                return
+            try:
+                result = service.infer(left, right, deadline_ms=deadline_ms)
+            except Overloaded as e:
+                if e.draining:
+                    self._reply_json(503, {"error": str(e)},
+                                     extra_headers=[("Retry-After", "5")])
+                else:
+                    self._reply_json(429, {"error": str(e)},
+                                     extra_headers=[("Retry-After", "1")])
+                return
+            except DeadlineExceeded as e:
+                self._reply_json(504, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — model/device failure
+                log.exception("inference failed")
+                self._reply_json(500, {"error": str(e)})
+                return
+            payload, ctype = _encode_disparity(result.disparity, fmt)
+            self._reply(200, payload, ctype, extra_headers=[
+                ("X-Queue-Wait-Ms", f"{result.queue_wait_s * 1e3:.2f}"),
+                ("X-Device-Ms", f"{result.device_s * 1e3:.2f}"),
+                ("X-Batch-Size", str(result.batch_size))])
+
+    return Handler
+
+
+class StereoHTTPServer:
+    """Owns the ThreadingHTTPServer; ``port=0`` binds an ephemeral port
+    (tests).  ``serve_forever`` blocks (the CLI's mode); ``start`` runs it
+    on a daemon thread (in-process tests)."""
+
+    def __init__(self, service: StereoService, host: str = "127.0.0.1",
+                 port: int = 8551):
+        self.service = service
+        self.server = ThreadingHTTPServer((host, port),
+                                          make_handler(service))
+        self._thread = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def serve_forever(self):
+        self.server.serve_forever()
+
+    def start(self) -> "StereoHTTPServer":
+        import threading
+
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="stereo-http")
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,337 @@
+"""Concurrent stereo-depth service: batcher front door + device worker pool.
+
+Turns the single-image ``eval.runner.InferenceRunner`` into a
+traffic-handling subsystem.  Requests enter through ``submit`` (or the
+blocking ``infer``), are grouped by /32-padded shape bucket in the
+``MicroBatcher``, and micro-batches run on a pool of device workers — one
+per local device for data-parallel dispatch — each owning an
+``InferenceRunner`` whose bounded per-(shape, batch) compile cache this
+service inherits unchanged.
+
+Two batch execution modes, because they trade differently:
+
+* ``"chain"`` (default) — every image in the micro-batch runs through the
+  SAME compiled batch-1 executable the solo ``InferenceRunner.__call__``
+  uses; the N forwards are dispatched back-to-back (JAX async dispatch
+  pipelines them) and synced once at the batch fetch.  One executable per
+  padded shape regardless of batch size, and results are **bitwise equal**
+  to a solo run of the same image (tests/test_serving.py asserts it) —
+  batching amortizes the per-image host sync + Python overhead without
+  touching numerics.
+* ``"stack"`` — the micro-batch is stacked into ONE batched dispatch,
+  batch-padded to the next power of two (at most log2(max_batch)+1
+  executables per shape).  Maximum amortization of per-dispatch overhead —
+  the right mode behind a high-RTT device tunnel — but a batch-N
+  executable reassociates differently from batch-1 (~1e-5 drift, the
+  documented run_batch trade; tests/test_cli.py).
+
+Shutdown mirrors the train loop's preemption story (training/train_loop.py):
+``drain()`` refuses new work with the typed ``Overloaded``, flushes the
+queue, finishes in-flight batches, and only then stops the workers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu import profiling
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.eval.runner import InferenceRunner
+from raft_stereo_tpu.ops.padding import InputPadder
+from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
+                                             Overloaded, Request)
+from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
+
+log = logging.getLogger(__name__)
+
+BATCH_MODES = ("chain", "stack")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (model architecture stays in RaftStereoConfig)."""
+
+    max_batch: int = 8           # flush a bucket at this many requests
+    max_wait_ms: float = 5.0     # ... or when its oldest waited this long
+    max_queue: int = 64          # admission bound; beyond it -> Overloaded
+    batch_mode: str = "chain"    # see module docstring
+    data_parallel: int = 1       # device workers (<= local device count)
+    iters: int = 32              # GRU iterations per request
+    shape_bucket: Optional[int] = None   # coarser-than-/32 padding grid
+    max_cached_shapes: int = 16  # per-worker compile cache bound
+    fetch_dtype: Optional[str] = None    # "fp16" | "bf16" half fetch
+    default_deadline_ms: Optional[float] = None  # per-request override wins
+
+    def __post_init__(self):
+        if self.batch_mode not in BATCH_MODES:
+            raise ValueError(
+                f"batch_mode={self.batch_mode!r} not in {BATCH_MODES}")
+        if self.data_parallel < 1:
+            raise ValueError(f"data_parallel={self.data_parallel} must be "
+                             f">= 1")
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One answered request: the flow plus its latency decomposition."""
+
+    flow: np.ndarray             # (H, W) x-flow (= -disparity), float32
+    queue_wait_s: float          # admission -> worker pickup
+    device_s: float              # dispatch -> outputs ready (advisory
+    #                              behind an async tunnel; see metrics.py)
+    fetch_s: float               # device->host result transfer
+    total_s: float               # admission -> result ready
+    batch_size: int              # occupancy of the micro-batch it rode in
+
+    @property
+    def disparity(self) -> np.ndarray:
+        """Positive disparity (the user-facing convention, cli/demo.py)."""
+        return -self.flow
+
+
+@dataclasses.dataclass
+class _Payload:
+    """What the service parks in Request.payload: padded inputs + unpadder."""
+
+    left: np.ndarray             # (Hp, Wp, 3) host-padded
+    right: np.ndarray
+    padder: InputPadder
+
+
+_STOP = object()
+
+
+class StereoService:
+    """The concurrent front door over ``InferenceRunner``.
+
+    ``devices`` defaults to the first ``serve_cfg.data_parallel`` local JAX
+    devices; each gets a worker thread with the variables resident on that
+    device, so same-bucket micro-batches dispatch data-parallel across the
+    pool.
+    """
+
+    def __init__(self, config: RaftStereoConfig, variables,
+                 serve_cfg: ServeConfig = ServeConfig(),
+                 devices: Optional[Sequence] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        import jax
+
+        self.serve_cfg = serve_cfg
+        if devices is None:
+            local = jax.local_devices()
+            if serve_cfg.data_parallel > len(local):
+                raise ValueError(
+                    f"data_parallel={serve_cfg.data_parallel} exceeds the "
+                    f"{len(local)} local devices")
+            devices = local[:serve_cfg.data_parallel]
+        self.devices = list(devices)
+        self.metrics = ServingMetrics(registry,
+                                      max_batch=serve_cfg.max_batch)
+        # Per-worker runner: variables live on that worker's device, and the
+        # bounded per-(padded shape, batch) compile cache is per worker.
+        self._runners: List[InferenceRunner] = []
+        for dev in self.devices:
+            self._runners.append(InferenceRunner(
+                config, jax.device_put(variables, dev),
+                iters=serve_cfg.iters, shape_bucket=serve_cfg.shape_bucket,
+                max_cached_shapes=serve_cfg.max_cached_shapes,
+                fetch_dtype=serve_cfg.fetch_dtype))
+        self.config = self._runners[0].config
+        self._divis = self._runners[0].divis_by
+        # Handoff between the batcher's flush thread and the workers: small
+        # and bounded so a saturated pool stalls flushing (the backpressure
+        # path) instead of accumulating dispatched-but-unstarted batches.
+        self._work: "queue.Queue" = queue.Queue(maxsize=len(self.devices))
+        self._workers = [
+            threading.Thread(target=self._worker_loop, args=(r, d),
+                             daemon=True, name=f"stereo-worker-{i}")
+            for i, (r, d) in enumerate(zip(self._runners, self.devices))]
+        for t in self._workers:
+            t.start()
+        self.batcher = MicroBatcher(
+            dispatch=self._dispatch, max_batch=serve_cfg.max_batch,
+            max_wait_ms=serve_cfg.max_wait_ms, max_queue=serve_cfg.max_queue,
+            metrics=self.metrics)
+        self._closed = False
+
+    # ------------------------------------------------------------ front door
+    def bucket_for(self, shape: Tuple[int, int, int]) -> Tuple[int, int]:
+        """The padded (Hp, Wp) this image shape dispatches at."""
+        padder = InputPadder((1,) + tuple(shape), divis_by=self._divis)
+        l, r, t, b = padder.pads
+        return (shape[0] + t + b, shape[1] + l + r)
+
+    def submit(self, left: np.ndarray, right: np.ndarray,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one stereo pair; returns a Future of ``ServeResult``.
+
+        Raises ``Overloaded`` at the door when the queue is full or the
+        service is draining; the Future fails with ``DeadlineExceeded`` if
+        the request's deadline passes before a device picks it up.
+        """
+        left, right = np.asarray(left), np.asarray(right)
+        if left.ndim != 3 or left.shape != right.shape:
+            raise ValueError(
+                f"need two same-shape (H, W, 3) images, got {left.shape} "
+                f"vs {right.shape}")
+        padder = InputPadder((1,) + left.shape, divis_by=self._divis)
+        l, r, t, b = padder.pads
+        spec = ((t, b), (l, r), (0, 0))
+        payload = _Payload(left=np.pad(left, spec, mode="edge"),
+                           right=np.pad(right, spec, mode="edge"),
+                           padder=padder)
+        now = time.monotonic()
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else self.serve_cfg.default_deadline_ms)
+        req = Request(bucket=payload.left.shape[:2], payload=payload,
+                      future=Future(), t_enqueue=now,
+                      deadline=(None if deadline_ms is None
+                                else now + deadline_ms / 1e3))
+        self.batcher.submit(req)   # raises Overloaded at the door
+        return req.future
+
+    def infer(self, left: np.ndarray, right: np.ndarray,
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> ServeResult:
+        """Blocking convenience: submit + wait (the in-process client)."""
+        return self.submit(left, right, deadline_ms).result(timeout=timeout)
+
+    # --------------------------------------------------------------- workers
+    def _dispatch(self, batch: List[Request]) -> None:
+        """Batcher flush -> worker pool handoff.  Inflight is counted from
+        HERE (not worker pickup) so ``drain``'s inflight==0 check covers
+        batches parked in the handoff queue; the bounded ``put`` is the
+        backpressure stall when the pool is saturated."""
+        self.metrics.inflight.inc(len(batch))
+        self._work.put(batch)
+
+    def _worker_loop(self, runner: InferenceRunner, device) -> None:
+        while True:
+            batch = self._work.get()
+            if batch is _STOP:
+                return
+            try:
+                self._run_batch(runner, device, batch)
+            except BaseException as e:  # noqa: BLE001 — fail the batch, not
+                self.metrics.failed.inc(len(batch))       # the worker thread
+                log.exception("micro-batch of %d failed", len(batch))
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            finally:
+                self.metrics.inflight.dec(len(batch))
+
+    def _run_batch(self, runner: InferenceRunner, device,
+                   batch: List[Request]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        t_pickup = time.monotonic()
+        waits = [t_pickup - r.t_enqueue for r in batch]
+        bucket = batch[0].bucket
+        n = len(batch)
+
+        with profiling.annotate("serve.device"):
+            if self.serve_cfg.batch_mode == "chain":
+                # N batch-1 dispatches through the one per-shape executable
+                # (bitwise-identical to solo InferenceRunner), pipelined by
+                # async dispatch, synced once below.
+                fwd = runner._forward_for(bucket, batch=1)
+                outs = [fwd(runner.variables,
+                            jax.device_put(r.payload.left[None], device),
+                            jax.device_put(r.payload.right[None], device))
+                        for r in batch]
+            else:
+                # "stack": one batched dispatch.  The batch axis pads to the
+                # next power of two (not to max_batch): compiles per shape
+                # stay bounded at log2(max_batch)+1 executables while a
+                # half-full flush wastes at most ~2x filler compute instead
+                # of always paying the full max_batch forward.
+                nb = 1 << (n - 1).bit_length()
+                p1 = np.stack([r.payload.left for r in batch]
+                              + [batch[-1].payload.left] * (nb - n))
+                p2 = np.stack([r.payload.right for r in batch]
+                              + [batch[-1].payload.right] * (nb - n))
+                fwd = runner._forward_for(bucket, batch=nb)
+                stacked = fwd(runner.variables,
+                              jax.device_put(p1, device),
+                              jax.device_put(p2, device))
+                outs = [stacked[i] for i in range(n)]
+            # Advisory device clock: honest on a local backend; behind an
+            # async tunnel readiness reports at dispatch (profiling.py) and
+            # only the fetch below is a real stop clock.
+            for o in outs:
+                jax.block_until_ready(o)
+        t_ready = time.monotonic()
+
+        with profiling.annotate("serve.fetch"):
+            flows_padded = [np.asarray(o) for o in outs]
+        t_fetched = time.monotonic()
+
+        device_s = t_ready - t_pickup
+        fetch_s = t_fetched - t_ready
+        self.metrics.batches.inc()
+        self.metrics.batch_occupancy.observe(n)
+        self.metrics.device_time.observe(device_s)
+        self.metrics.fetch_time.observe(fetch_s)
+        for r, fp, wait in zip(batch, flows_padded, waits):
+            fp = fp if fp.ndim == 3 else fp[None]        # stack mode: (Hp,Wp)
+            flow = r.payload.padder.unpad(fp)[0]
+            if flow.dtype != np.float32:                 # half-precision fetch
+                flow = flow.astype(np.float32)
+            total = t_fetched - r.t_enqueue
+            self.metrics.queue_wait.observe(wait)
+            self.metrics.total_latency.observe(total)
+            self.metrics.completed.inc()
+            r.future.set_result(ServeResult(
+                flow=np.ascontiguousarray(flow), queue_wait_s=wait,
+                device_s=device_s, fetch_s=fetch_s, total_s=total,
+                batch_size=n))
+
+    # -------------------------------------------------------------- shutdown
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful SIGTERM story: refuse new work (``Overloaded``), flush
+        the queue, finish in-flight batches, stop the workers.  Returns
+        False if ``timeout`` elapsed first (workers are still stopped; any
+        stranded requests fail rather than hang)."""
+        t0 = time.monotonic()
+        ok = self.batcher.drain(timeout=timeout)
+        # Wait for the work queue + in-flight batches to finish.
+        remaining = (None if timeout is None
+                     else max(0.0, timeout - (time.monotonic() - t0)))
+        deadline = None if remaining is None else time.monotonic() + remaining
+        while self.metrics.inflight.value > 0:
+            if deadline is not None and time.monotonic() > deadline:
+                ok = False
+                break
+            time.sleep(0.002)
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        """Hard stop: ends the batcher (queued requests fail with
+        ``Overloaded``) and the worker threads.  ``drain`` first for the
+        graceful version."""
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close()
+        for _ in self._workers:
+            self._work.put(_STOP)
+        for t in self._workers:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

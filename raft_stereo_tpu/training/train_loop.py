@@ -14,6 +14,7 @@ async dispatch.  Improvements over the reference, by design:
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import logging
 import os
 import signal
@@ -37,6 +38,35 @@ from raft_stereo_tpu.training.state import TrainState, create_train_state
 from raft_stereo_tpu.training.step import make_train_step
 
 log = logging.getLogger(__name__)
+
+# Config fields that choose HOW the graph executes — backends, precision,
+# sharding, remat, memory gates — not WHAT the weights are.  A weights-only
+# warm start must take these from the CALLER's config: train() has already
+# built the mesh and the corr/rows sharding contexts from it, and the .pth
+# warm-start branch honors it the same way (import_torch_checkpoint's
+# config= argument).  The checkpoint stays authoritative for the
+# weight-shaping architecture fields (hidden_dims, n_gru_layers,
+# corr_levels, ...), which is the point of a warm start.
+_EXEC_CONFIG_FIELDS = (
+    "corr_backend", "slow_fast_gru", "mixed_precision", "corr_fp32",
+    "banded_encoder", "corr_w2_shards", "rows_shards", "rows_gru",
+    "rows_gru_halo", "remat_gru", "remat_save", "sequential_fnet_pixels",
+    "band_rows")
+
+
+def merge_warm_start_config(caller_cfg: RaftStereoConfig,
+                            ckpt_cfg: RaftStereoConfig) -> RaftStereoConfig:
+    """Checkpoint architecture + caller execution-level overrides.
+
+    Fixes the ADVICE.md round-5 finding: the orbax warm-start branch used to
+    adopt the checkpoint's config wholesale, silently discarding CLI
+    --rows_shards/--rows_gru/--corr_w2_shards/--mixed_precision passed
+    alongside --warm_start — and conversely demanding mesh axes the
+    already-built mesh lacks when the checkpoint was saved sharded."""
+    return dataclasses.replace(
+        ckpt_cfg,
+        **{f: getattr(caller_cfg, f) for f in _EXEC_CONFIG_FIELDS})
+
 
 # Batches uploaded to the device ahead of the step dispatch (per-step HBM
 # cost: depth x batch bytes).  Behind a remote device tunnel the synchronous
@@ -207,9 +237,12 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                               batch_stats=variables.get("batch_stats", {}))
         log.info("warm start from torch checkpoint %s", restore)
     elif restore and warm_start:
-        # weights-only fine-tune start from one of our orbax checkpoints
+        # weights-only fine-tune start from one of our orbax checkpoints;
+        # execution-level fields stay the caller's (the mesh and sharding
+        # contexts were built from them — merge_warm_start_config)
         from raft_stereo_tpu.training.checkpoint import load_weights
-        model_cfg, variables = load_weights(restore)
+        ckpt_cfg, variables = load_weights(restore)
+        model_cfg = merge_warm_start_config(model_cfg, ckpt_cfg)
         state = create_train_state(model_cfg, train_cfg, rng, init_shape)
         state = state.replace(params=variables["params"],
                               batch_stats=variables.get("batch_stats", {}))

@@ -1,0 +1,504 @@
+"""Serving subsystem tests (tier-1, CPU).
+
+Batcher policy tests run against an injected dispatch callable — no JAX at
+all — so bucket grouping, timed flush, deadline triage, shedding, and drain
+are exercised in milliseconds.  Service-level tests run a REAL tiny model:
+the headline assertions are (a) a micro-batched response is **bitwise
+equal** to the same image run alone through ``InferenceRunner`` (chain
+mode's contract), and (b) a burst beyond capacity sheds with the typed
+``Overloaded`` while everything admitted still completes.
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
+                                             Overloaded, Request)
+from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
+
+# Pure-XLA backend: the serving tests assert bitwise properties and must
+# not depend on the Pallas kernels' CPU interpret path.
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+ITERS = 1
+
+
+# --------------------------------------------------------------- batcher
+class _Collector:
+    """Dispatch sink recording batches; optionally blocks until released."""
+
+    def __init__(self, block: bool = False):
+        self.batches = []
+        self.event = threading.Event()
+        self._gate = threading.Event()
+        if not block:
+            self._gate.set()
+
+    def __call__(self, batch):
+        self._gate.wait()
+        self.batches.append(batch)
+        self.event.set()
+
+    def release(self):
+        self._gate.set()
+
+
+def _req(bucket=(64, 96), deadline_s=None):
+    now = time.monotonic()
+    return Request(bucket=bucket, payload=None, future=Future(),
+                   t_enqueue=now,
+                   deadline=None if deadline_s is None else now + deadline_s)
+
+
+def test_batcher_flushes_full_bucket_immediately():
+    sink = _Collector()
+    b = MicroBatcher(sink, max_batch=3, max_wait_ms=10_000, max_queue=16)
+    try:
+        reqs = [_req() for _ in range(3)]
+        for r in reqs:
+            b.submit(r)
+        assert sink.event.wait(timeout=5.0), "full bucket must flush at once"
+        assert [len(x) for x in sink.batches] == [3]
+        assert sink.batches[0] == reqs  # FIFO order preserved
+    finally:
+        b.close()
+
+
+def test_batcher_groups_by_shape_bucket():
+    sink = _Collector()
+    b = MicroBatcher(sink, max_batch=2, max_wait_ms=10_000, max_queue=16)
+    try:
+        a1, a2 = _req(bucket=(64, 96)), _req(bucket=(64, 96))
+        c1, c2 = _req(bucket=(96, 128)), _req(bucket=(96, 128))
+        for r in (a1, c1, a2, c2):  # interleaved submission
+            b.submit(r)
+        deadline = time.monotonic() + 5.0
+        while len(sink.batches) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sorted(tuple(r.bucket for r in batch)
+                      for batch in sink.batches) == [
+            ((64, 96), (64, 96)), ((96, 128), (96, 128))]
+    finally:
+        b.close()
+
+
+def test_batcher_max_wait_flushes_partial_bucket():
+    sink = _Collector()
+    b = MicroBatcher(sink, max_batch=8, max_wait_ms=30, max_queue=16)
+    try:
+        t0 = time.monotonic()
+        b.submit(_req())
+        b.submit(_req())
+        assert sink.event.wait(timeout=5.0)
+        elapsed = time.monotonic() - t0
+        assert [len(x) for x in sink.batches] == [2]
+        assert elapsed >= 0.025, "must not flush before max_wait"
+    finally:
+        b.close()
+
+
+def test_batcher_deadline_rejection_at_dispatch():
+    sink = _Collector()
+    b = MicroBatcher(sink, max_batch=8, max_wait_ms=50, max_queue=16)
+    try:
+        dead = _req(deadline_s=0.001)   # expires long before the 50 ms flush
+        live = _req(deadline_s=30.0)
+        b.submit(dead)
+        b.submit(live)
+        with pytest.raises(DeadlineExceeded):
+            dead.future.result(timeout=5.0)
+        assert sink.event.wait(timeout=5.0)
+        assert [len(x) for x in sink.batches] == [1]  # only the live one
+        assert sink.batches[0][0] is live
+        assert b.metrics.deadline_missed.value == 1
+    finally:
+        b.close()
+
+
+def test_batcher_queue_full_sheds_with_typed_overloaded():
+    sink = _Collector(block=True)   # saturated worker pool
+    b = MicroBatcher(sink, max_batch=2, max_wait_ms=10_000, max_queue=4)
+    try:
+        for _ in range(4):
+            b.submit(_req())
+        # bucket flushes at 2, but dispatch is blocked -> 2 drain at most
+        time.sleep(0.05)
+        shed = 0
+        for _ in range(6):
+            try:
+                b.submit(_req())
+            except Overloaded as e:
+                assert not e.draining
+                shed += 1
+        assert shed > 0, "bounded queue must shed past max_queue"
+        assert b.metrics.rejected_queue_full.value == shed
+        assert b.depth <= 4
+    finally:
+        sink.release()
+        b.close()
+
+
+def test_batcher_drain_flushes_then_refuses():
+    sink = _Collector()
+    b = MicroBatcher(sink, max_batch=8, max_wait_ms=60_000, max_queue=16)
+    try:
+        reqs = [_req() for _ in range(3)]
+        for r in reqs:
+            b.submit(r)
+        assert not sink.batches, "nothing is due before max_wait"
+        assert b.drain(timeout=5.0), "drain must flush the queue"
+        assert [len(x) for x in sink.batches] == [3]
+        with pytest.raises(Overloaded) as ei:
+            b.submit(_req())
+        assert ei.value.draining
+        assert b.metrics.rejected_draining.value == 1
+    finally:
+        b.close()
+
+
+def test_batcher_close_fails_orphans():
+    sink = _Collector(block=True)
+    b = MicroBatcher(sink, max_batch=1, max_wait_ms=10_000, max_queue=16)
+    inflight = _req()
+    b.submit(inflight)       # dispatched, stuck in the blocked sink
+    time.sleep(0.05)
+    orphan = _req()
+    b.submit(orphan)
+    b.close()
+    with pytest.raises(Overloaded):
+        orphan.future.result(timeout=5.0)
+    sink.release()
+
+
+# --------------------------------------------------------------- metrics
+def test_metrics_exposition_and_percentiles():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests")
+    g = reg.gauge("depth", "queue depth")
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    c.inc(3)
+    g.set(7)
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.render_text()
+    assert "# TYPE reqs_total counter" in text
+    assert "reqs_total 3" in text
+    assert "depth 7" in text
+    assert 'lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "lat_seconds_count 4" in text
+    assert h.percentile(50) == pytest.approx(np.percentile(
+        [0.005, 0.05, 0.5, 5.0], 50))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("reqs_total")
+    # the standard serving instrument set renders as one scrape
+    sm = ServingMetrics(max_batch=4)
+    sm.admitted.inc()
+    assert "serve_requests_admitted_total 1" in sm.render_text()
+
+
+# --------------------------------------------------------------- service
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+def _pairs(n, hw=(48, 64), seed=3):
+    rng = np.random.default_rng(seed)
+    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8).astype(np.uint8)
+             for _ in range(n)]
+    rights = [np.roll(l, -3, axis=1) for l in lefts]
+    return lefts, rights
+
+
+def test_service_batched_bitwise_parity_with_solo_runner(tiny_model):
+    """The acceptance property: a response that rode a micro-batch is
+    bitwise equal to the same pair run alone through InferenceRunner
+    (chain mode dispatches through the identical batch-1 program)."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    lefts, rights = _pairs(3)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=3, max_wait_ms=200,
+                                   iters=ITERS)) as svc:
+        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+        results = [f.result(timeout=120) for f in futures]
+    assert all(r.batch_size == 3 for r in results), \
+        "the three submits must ride one micro-batch"
+    for (l, r), res in zip(zip(lefts, rights), results):
+        solo_flow, _ = solo(l, r)
+        assert res.flow.shape == solo_flow.shape == (48, 64)
+        assert np.array_equal(res.flow, solo_flow), \
+            "batched response must be bitwise-equal to solo inference"
+        assert res.queue_wait_s >= 0 and res.total_s > 0
+        np.testing.assert_array_equal(res.disparity, -res.flow)
+
+
+def test_service_buckets_mixed_shapes_and_unpads_exactly(tiny_model):
+    """Different raw shapes that pad to one /32 bucket batch together and
+    unpad back to their own sizes; a different bucket compiles separately."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    shapes = [(48, 64), (40, 56), (48, 96)]   # -> (64,64), (64,64), (64,96)
+    rng = np.random.default_rng(11)
+    pairs = [(rng.integers(0, 255, s + (3,), dtype=np.uint8),) * 2
+             for s in shapes]
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=4, max_wait_ms=30,
+                                   iters=ITERS)) as svc:
+        assert svc.bucket_for((48, 64, 3)) == (64, 64)
+        assert svc.bucket_for((40, 56, 3)) == (64, 64)
+        assert svc.bucket_for((48, 96, 3)) == (64, 96)
+        futures = [svc.submit(l, r) for l, r in pairs]
+        results = [f.result(timeout=120) for f in futures]
+        for (l, r), res, shape in zip(pairs, results, shapes):
+            assert res.flow.shape == shape
+            solo_flow, _ = solo(l, r)
+            assert np.array_equal(res.flow, solo_flow)
+        # metrics saw every stage
+        m = svc.metrics
+        assert m.completed.value == 3
+        assert m.batches.value >= 2          # two distinct buckets
+        assert m.queue_wait.count == 3 and m.total_latency.count == 3
+
+
+def test_service_overload_burst_sheds_and_completes_admitted(tiny_model):
+    """Acceptance: a burst of more requests than capacity hits the bounded
+    queue — typed Overloaded for the overflow, completion for everything
+    admitted, and the accounting adds up."""
+    from raft_stereo_tpu.serving import Overloaded, ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, max_wait_ms=1.0, max_queue=4,
+                                   iters=ITERS)) as svc:
+        svc.infer(lefts[0], rights[0], timeout=120)   # warm the executable
+        futures, shed = [], 0
+        for _ in range(40):
+            try:
+                futures.append(svc.submit(lefts[0], rights[0]))
+            except Overloaded:
+                shed += 1
+        assert shed > 0, "burst beyond max_queue must shed"
+        results = [f.result(timeout=120) for f in futures]
+        assert all(np.isfinite(r.flow).all() for r in results)
+        m = svc.metrics
+        assert m.admitted.value == 1 + len(futures)
+        assert m.rejected_queue_full.value == shed
+        assert m.completed.value == 1 + len(futures)
+        assert m.batch_occupancy.count == m.batches.value
+        # occupancy never exceeds the configured max_batch
+        assert m.batch_occupancy.percentile(100) <= 2
+
+
+def test_service_drain_finishes_queued_then_refuses(tiny_model):
+    """The SIGTERM story: drain() completes queued + in-flight work, then
+    the door is closed with the typed draining rejection."""
+    from raft_stereo_tpu.serving import Overloaded, ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=4, max_wait_ms=60_000,
+                                    iters=ITERS))
+    try:
+        futures = [svc.submit(lefts[0], rights[0]) for _ in range(3)]
+        # nothing flushes on its own (max_wait is a minute); drain must
+        assert svc.drain(timeout=120)
+        for f in futures:
+            assert np.isfinite(f.result(timeout=1).flow).all()
+        with pytest.raises(Overloaded) as ei:
+            svc.submit(lefts[0], rights[0])
+        assert ei.value.draining
+    finally:
+        svc.close()
+
+
+def test_serve_config_validation(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    with pytest.raises(ValueError, match="batch_mode"):
+        ServeConfig(batch_mode="magic")
+    with pytest.raises(ValueError, match="data_parallel"):
+        ServeConfig(data_parallel=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        StereoService(cfg, variables, ServeConfig(data_parallel=512))
+
+
+def test_service_stack_mode_close_to_solo(tiny_model):
+    """Stack mode (one batched dispatch, batch-padded to max_batch) stays
+    within the documented cross-batch-size reassociation drift."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    lefts, rights = _pairs(3)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=4, max_wait_ms=50,
+                                   batch_mode="stack", iters=ITERS)) as svc:
+        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+        for (l, r), f in zip(zip(lefts, rights), futures):
+            res = f.result(timeout=120)
+            solo_flow, _ = solo(l, r)
+            np.testing.assert_allclose(res.flow, solo_flow, atol=5e-4)
+
+
+def test_service_data_parallel_workers(tiny_model):
+    """Multiple device workers (the 8 virtual CPU devices) serve the same
+    traffic with the same chain-mode parity."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    lefts, rights = _pairs(4)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, max_wait_ms=5.0,
+                                   data_parallel=2, iters=ITERS)) as svc:
+        assert len(svc.devices) == 2
+        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+        for (l, r), f in zip(zip(lefts, rights), futures):
+            res = f.result(timeout=120)
+            solo_flow, _ = solo(l, r)
+            assert np.array_equal(res.flow, solo_flow)
+
+
+def test_serve_cli_builds_service_from_checkpoint(tiny_model, tmp_path):
+    """cli.serve: argparse -> checkpoint load -> configured service (the
+    raft-serve console path minus the blocking HTTP loop)."""
+    from raft_stereo_tpu.cli.serve import build_parser, build_service
+    from raft_stereo_tpu.training.checkpoint import save_weights
+
+    cfg, variables = tiny_model
+    path = str(tmp_path / "ckpt")
+    save_weights(path, cfg, variables["params"],
+                 variables.get("batch_stats"))
+    args = build_parser().parse_args(
+        ["--restore_ckpt", path, "--valid_iters", str(ITERS),
+         "--max_batch", "2", "--max_wait_ms", "3", "--max_queue", "8",
+         "--deadline_ms", "60000"])
+    svc = build_service(args)
+    try:
+        assert svc.serve_cfg.max_batch == 2
+        assert svc.serve_cfg.default_deadline_ms == 60000
+        lefts, rights = _pairs(1)
+        res = svc.infer(lefts[0], rights[0], timeout=120)
+        assert res.flow.shape == (48, 64) and np.isfinite(res.flow).all()
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------------------ http
+@pytest.fixture()
+def http_server(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=2, max_wait_ms=5.0,
+                                    iters=ITERS))
+    server = StereoHTTPServer(svc, port=0).start()
+    yield server
+    server.shutdown()
+    svc.close()
+
+
+def _post(url, body, content_type="application/x-npz", headers=()):
+    req = urllib.request.Request(url, data=body, method="POST")
+    req.add_header("Content-Type", content_type)
+    for k, v in headers:
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_disparity_npz_to_npy_and_metrics(http_server, tiny_model):
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    buf = io.BytesIO()
+    np.savez(buf, left=lefts[0], right=rights[0])
+    status, headers, body = _post(http_server.url + "/v1/disparity",
+                                  buf.getvalue())
+    assert status == 200
+    disp = np.load(io.BytesIO(body))
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    assert np.array_equal(disp, -solo(lefts[0], rights[0])[0])
+    assert "X-Batch-Size" in headers and "X-Queue-Wait-Ms" in headers
+
+    with urllib.request.urlopen(http_server.url + "/metrics",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+    assert "serve_requests_completed_total 1" in text
+    assert "serve_total_latency_seconds_count 1" in text
+
+    with urllib.request.urlopen(http_server.url + "/healthz",
+                                timeout=30) as resp:
+        health = json.loads(resp.read())
+    assert health["status"] == "ok" and health["devices"] == 1
+
+
+def test_http_png_pair_roundtrip(http_server):
+    from PIL import Image
+
+    lefts, rights = _pairs(1)
+    pair = np.concatenate([lefts[0], rights[0]], axis=1)  # side-by-side
+    buf = io.BytesIO()
+    Image.fromarray(pair).save(buf, format="PNG")
+    status, _, body = _post(http_server.url + "/v1/disparity?format=png",
+                            buf.getvalue(), content_type="image/png")
+    assert status == 200
+    png = np.asarray(Image.open(io.BytesIO(body)))
+    assert png.dtype == np.uint16 and png.shape == (48, 64)
+
+    # npy response for the same pair agrees with the 16-bit encoding
+    status, _, body = _post(http_server.url + "/v1/disparity",
+                            buf.getvalue(), content_type="image/png")
+    disp = np.load(io.BytesIO(body))
+    np.testing.assert_allclose(png / 256.0, np.clip(disp, 0, None),
+                               atol=1 / 256.0)
+
+
+def test_http_error_mapping(http_server):
+    status, _, body = _post(http_server.url + "/v1/disparity", b"not an npz")
+    assert status == 400 and b"error" in body
+    status, _, _ = _post(http_server.url + "/nope", b"x")
+    assert status == 404
+    # malformed format parameter
+    lefts, rights = _pairs(1)
+    buf = io.BytesIO()
+    np.savez(buf, left=lefts[0], right=rights[0])
+    status, _, _ = _post(http_server.url + "/v1/disparity?format=tiff",
+                         buf.getvalue())
+    assert status == 400

@@ -262,6 +262,74 @@ def test_train_rejects_more_corr_shards_than_devices():
               TrainConfig(batch_size=2, num_steps=1))
 
 
+def test_merge_warm_start_config_splits_arch_from_execution():
+    """ADVICE.md round-5 medium: a weights-only warm start takes the
+    ARCHITECTURE from the checkpoint but the EXECUTION-level fields
+    (sharding, precision, backends, remat) from the caller — train() built
+    the mesh and sharding contexts from the caller's config, so adopting
+    the checkpoint's rows_shards/corr_w2_shards wholesale could demand a
+    mesh axis the mesh lacks (and silently discarded CLI overrides)."""
+    from raft_stereo_tpu.training.train_loop import merge_warm_start_config
+
+    caller = RaftStereoConfig(hidden_dims=(48, 48, 48), corr_backend="reg",
+                              mixed_precision=False, rows_shards=1)
+    ckpt = RaftStereoConfig(hidden_dims=(32, 32, 32), n_gru_layers=2,
+                            corr_backend="reg_fused", mixed_precision=True,
+                            rows_shards=2, rows_gru=True, slow_fast_gru=True)
+    merged = merge_warm_start_config(caller, ckpt)
+    # weight-shaping fields: checkpoint's
+    assert merged.hidden_dims == (32, 32, 32)
+    assert merged.n_gru_layers == 2
+    # execution-level fields: caller's (the mesh was built from these)
+    assert merged.rows_shards == 1 and not merged.rows_gru
+    assert not merged.mixed_precision and not merged.slow_fast_gru
+    assert merged.corr_backend == "reg"
+
+
+def test_warm_start_keeps_caller_execution_config(tmp_path):
+    """End-to-end regression for the same finding: --warm_start from an
+    orbax checkpoint saved under different execution settings runs with
+    the caller's execution config and the checkpoint's architecture.  The
+    run's own final checkpoint embeds the authoritative model_cfg, so it
+    is the observation channel (num_steps=0 exercises the restore branch
+    without a train step)."""
+    import os
+
+    from raft_stereo_tpu.data.loader import StereoLoader
+    from raft_stereo_tpu.training.checkpoint import (load_checkpoint,
+                                                     save_weights)
+    from raft_stereo_tpu.training.train_loop import train
+
+    ckpt_cfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,),
+                                fnet_dim=64, corr_backend="reg",
+                                mixed_precision=True, slow_fast_gru=True)
+    state = create_train_state(ckpt_cfg, TrainConfig(train_iters=1),
+                               jax.random.PRNGKey(0),
+                               image_shape=(1, 32, 64, 3))
+    wpath = str(tmp_path / "w")
+    save_weights(wpath, ckpt_cfg, state.params, state.batch_stats)
+
+    # caller asks for a DIFFERENT architecture (ignored — checkpoint wins)
+    # and different execution settings (honored — mesh was built from them)
+    caller_cfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(48,),
+                                  fnet_dim=64, corr_backend="reg",
+                                  mixed_precision=False)
+    tcfg = TrainConfig(batch_size=2, train_iters=1, num_steps=0,
+                       image_size=(32, 64), validation_frequency=1_000,
+                       data_parallel=1)
+    loader = StereoLoader(_SyntheticDataset(), batch_size=2, num_workers=0,
+                          shuffle=False)
+    final = train(caller_cfg, tcfg, name="ws",
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  log_dir=str(tmp_path / "runs"), loader=loader,
+                  restore=wpath, warm_start=True, use_mesh=False)
+    assert int(final.step) == 0
+    cfg, _ = load_checkpoint(os.path.join(str(tmp_path / "ck"), "ws"))
+    assert cfg.hidden_dims == (32,)            # architecture: checkpoint's
+    assert not cfg.mixed_precision             # execution: caller's
+    assert not cfg.slow_fast_gru
+
+
 def test_legacy_convzr_checkpoint_migrates(tmp_path):
     """Checkpoints saved before the convz/convr -> convzr gate fusion
     restore transparently: the loader retries against the split-gate layout
