@@ -26,6 +26,7 @@ roofline probes quantify it); compare trends, not single runs.  North star
 from __future__ import annotations
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +34,78 @@ import numpy as np
 
 BASELINE_FPS = 26.0  # reference realtime model on RTX 6000 (paper claim)
 KITTI_PADDED = (384, 1248)  # 375x1242 padded to /32 (evaluate_stereo.py:73)
+BENCH_ITERS = 7             # realtime model --valid_iters
 K_LO, K_HI = 3, 23
 REPEATS = 3
+BASELINE_JSON = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE.json")
+# Warn only past clear noise: chip-side variance behind this environment's
+# tunnel is ±20%+ run to run (module docstring), so a regression line below
+# that would fire on healthy runs.
+REGRESSION_FACTOR = 1.25
+
+
+def _seconds_per_forward(model, variables, img1, img2, iters):
+    from raft_stereo_tpu.profiling import (chained_seconds_per_call,
+                                           make_forward_chain)
+
+    # scalar float() fetch inside the chain = full sync even behind the
+    # async tunnel (see profiling.make_forward_chain)
+    make_chain = make_forward_chain(
+        lambda v, a, b: model.apply(v, a, b, iters=iters, test_mode=True)[1],
+        variables, img1, img2)
+    return chained_seconds_per_call(make_chain, k_lo=K_LO, k_hi=K_HI,
+                                    repeats=REPEATS)
+
+
+def phase_split(t_iters_s: float, t_one_iter_s: float, iters: int) -> dict:
+    """First-class encoder-vs-GRU attribution (the ad-hoc round-3
+    measurement, INFERENCE_PROFILE_r03.json): differencing the chained
+    ``iters``-iteration and 1-iteration forwards isolates the per-GRU-iter
+    cost; everything else (encoders, corr pyramid, final upsample,
+    dispatch) is the fixed remainder."""
+    per_iter = (t_iters_s - t_one_iter_s) / (iters - 1)
+    fixed = t_one_iter_s - per_iter
+    return {
+        "metric": "realtime_phase_split",
+        f"t_iters{iters}_ms": round(t_iters_s * 1e3, 3),
+        "t_iters1_ms": round(t_one_iter_s * 1e3, 3),
+        "per_gru_iter_ms": round(per_iter * 1e3, 4),
+        "encoder_and_fixed_ms": round(fixed * 1e3, 4),
+        f"gru_share_at_{iters}_iters": round(
+            per_iter * iters / t_iters_s, 3),
+    }
+
+
+def check_regression(split: dict, fps: float) -> list:
+    """Compare this run against BASELINE.json's published numbers; return
+    warn lines (printed as JSON) when a phase regressed past the noise
+    band.  Attribution first: the per-GRU-iter number is the one the fused
+    update-block kernel moves."""
+    warnings = []
+    try:
+        with open(BASELINE_JSON) as f:
+            published = json.load(f).get("published", {})
+    except (OSError, ValueError):
+        return warnings
+    ref = published.get("realtime_phase_split")
+    if ref:
+        for key in ("per_gru_iter_ms", "encoder_and_fixed_ms"):
+            if key in ref and split[key] > REGRESSION_FACTOR * ref[key]:
+                warnings.append({
+                    "warning": f"{key} regressed vs BASELINE.json",
+                    "value_ms": split[key],
+                    "baseline_ms": ref[key],
+                    "baseline_source": ref.get("source", "BASELINE.json"),
+                })
+    north_star = published.get("north_star_vs_baseline")
+    if north_star and fps / BASELINE_FPS < north_star / REGRESSION_FACTOR:
+        warnings.append({
+            "warning": "fps fell below the north-star band",
+            "vs_baseline": round(fps / BASELINE_FPS, 3),
+            "north_star": north_star,
+        })
+    return warnings
 
 
 def main():
@@ -54,16 +125,9 @@ def main():
                              iters=1, test_mode=True)
     )(jax.random.PRNGKey(0))
 
-    from raft_stereo_tpu.profiling import (chained_seconds_per_call,
-                                           make_forward_chain)
-
-    # scalar float() fetch inside the chain = full sync even behind the
-    # async tunnel (see profiling.make_forward_chain)
-    make_chain = make_forward_chain(
-        lambda v, a, b: model.apply(v, a, b, iters=7, test_mode=True)[1],
-        variables, img1, img2)
-    per_image = chained_seconds_per_call(make_chain, k_lo=K_LO, k_hi=K_HI,
-                                         repeats=REPEATS)
+    per_image = _seconds_per_forward(model, variables, img1, img2,
+                                     BENCH_ITERS)
+    t_one = _seconds_per_forward(model, variables, img1, img2, 1)
     fps = 1.0 / per_image
     print(json.dumps({
         "metric": "realtime_model_inference_fps_kitti_res",
@@ -71,6 +135,11 @@ def main():
         "unit": "frames/s",
         "vs_baseline": round(fps / BASELINE_FPS, 3),
     }))
+    split = phase_split(per_image, t_one, BENCH_ITERS)
+    split["fused_gru"] = cfg.fused_gru
+    print(json.dumps(split))
+    for warning in check_regression(split, fps):
+        print(json.dumps(warning))
 
 
 if __name__ == "__main__":

@@ -67,6 +67,21 @@ class RaftStereoConfig:
     context_norm: str = "batch"    # cnet norm (reference uses frozen batch norm)
     fnet_norm: str = "instance"
     fnet_dim: int = 256
+    # Fused ConvGRU gate kernel (kernels/gru_fused.py): compute both gate
+    # convolutions (convzr, convq) and the r-gate coupling of every GRU
+    # level in one Pallas launch per level, keeping the gate intermediates
+    # in VMEM — the scan body is ~89% of realtime inference at 7 iterations
+    # (INFERENCE_PROFILE_r03.json), and this collapses its ~10 XLA
+    # dispatches per level to 1 kernel + 1 fused pointwise tail.
+    #   "auto" (default): use the kernel when the backend supports it and
+    #     the level's working set fits VMEM; silently fall back to the Flax
+    #     conv path otherwise (CPU/GPU, very wide levels).
+    #   "on": force the kernel; raises when it cannot run.
+    #   "off": always the Flax conv path (bitwise-identical to the
+    #     pre-kernel graph; guarded by tests/test_gru_fused.py).
+    # Parameters are shared with the Flax path (same pytree), so the flag
+    # is a pure execution choice — checkpoints are unaffected.
+    fused_gru: str = "auto"
     # Rematerialize the GRU scan body in the backward pass (train mode only;
     # ``jax.checkpoint``).  Training stores per-iteration activations of
     # every conv in the update block otherwise — ~0.6 GB x train_iters at the
@@ -166,6 +181,9 @@ class RaftStereoConfig:
             raise ValueError(
                 "rows_shards and banded_encoder both replace the "
                 "full-resolution segment's executor — enable at most one")
+        if self.fused_gru not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_gru={self.fused_gru!r} not in ('auto', 'on', 'off')")
         object.__setattr__(self, "remat_save", tuple(self.remat_save))
         known_saves = {"corr_lookup", "gru_gates", "motion_features"}
         unknown = set(self.remat_save) - known_saves

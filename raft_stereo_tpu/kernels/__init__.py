@@ -1,0 +1,35 @@
+"""Pallas TPU kernel family — public API.
+
+Three kernels share one contract (docs/architecture.md §Kernels): an
+``*_available()`` capability gate, a VMEM working-set fit check that sizes
+(or vetoes) the launch, the package-wide interpret override so the tier-1
+CPU suite runs the kernel code path through the HLO interpreter, and a
+transparent fallback to the pure-XLA path when unavailable.
+
+Callers import from HERE; the submodules' underscored helpers are
+implementation detail.
+"""
+
+from raft_stereo_tpu.kernels.corr_alt import (alt_fused_available,
+                                              alt_fused_fits,
+                                              alt_lookup_fused)
+from raft_stereo_tpu.kernels.corr_lookup import (fused_lookup_available,
+                                                 interpret_enabled,
+                                                 lookup_pyramid_fused)
+from raft_stereo_tpu.kernels.gru_fused import (gru_fused_available,
+                                               gru_fused_row_block,
+                                               gru_fused_should_use,
+                                               gru_gates_fused)
+
+__all__ = [
+    "alt_fused_available",
+    "alt_fused_fits",
+    "alt_lookup_fused",
+    "fused_lookup_available",
+    "gru_fused_available",
+    "gru_fused_row_block",
+    "gru_fused_should_use",
+    "gru_gates_fused",
+    "interpret_enabled",
+    "lookup_pyramid_fused",
+]

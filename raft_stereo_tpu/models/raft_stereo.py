@@ -30,6 +30,7 @@ from raft_stereo_tpu.models.extractor import (BasicEncoder, MultiBasicEncoder,
 from raft_stereo_tpu.models.update import BasicMultiUpdateBlock
 from raft_stereo_tpu.ops.grids import coords_grid_x
 from raft_stereo_tpu.ops.upsample import convex_upsample
+from raft_stereo_tpu.profiling import annotate
 
 # Extra peak-HBM bytes PER PIXEL the batch-2 fnet concat costs over the
 # sequential path when the stem runs at full resolution (n_downsample<=2):
@@ -164,16 +165,21 @@ class RAFTStereo(nn.Module):
                     mvars.get("batch_stats", {}).get("trunk", {}),
                     x, norm_fn, dtype, mesh=rows_mesh, axis=rows_axis)
 
+        # Phase annotations (profiling.annotate = TraceAnnotation +
+        # jax.named_scope): device traces break out the same phases the
+        # bench's realtime_phase_split line reports.
         if cfg.shared_backbone:
             both = jnp.concatenate([image1, image2], axis=0)
-            if custom_trunk is not None:
-                levels, v = self.cnet(
-                    both, trunk_out=custom_trunk(self.cnet, both,
-                                                 cfg.context_norm))
-            else:
-                levels, v = self.cnet(both)
-            fmap = self.conv2_out(self.conv2_res(v))
-            fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
+            with annotate("cnet"):
+                if custom_trunk is not None:
+                    levels, v = self.cnet(
+                        both, trunk_out=custom_trunk(self.cnet, both,
+                                                     cfg.context_norm))
+                else:
+                    levels, v = self.cnet(both)
+            with annotate("fnet"):
+                fmap = self.conv2_out(self.conv2_res(v))
+                fmap1, fmap2 = jnp.split(fmap, 2, axis=0)
         elif (custom_trunk is not None or image1.shape[1] * image1.shape[2]
                 >= sequential_fnet_threshold(cfg)):
             # Full-resolution inputs: the stem runs at FULL image resolution
@@ -185,25 +191,29 @@ class RAFTStereo(nn.Module):
             # frames on a 16 GB chip or not (docs/TRAIN_PROFILE.md round 2).
             # With banded_encoder, each trunk additionally streams its
             # full-resolution stages band by band (models/banded.py).
-            levels, _ = self.cnet(
-                image1, trunk_out=custom_trunk(self.cnet, image1,
-                                               cfg.context_norm)
-                if custom_trunk is not None else None)
+            with annotate("cnet"):
+                levels, _ = self.cnet(
+                    image1, trunk_out=custom_trunk(self.cnet, image1,
+                                                   cfg.context_norm)
+                    if custom_trunk is not None else None)
 
             def fnet_one(module, carry, img):
                 trunk_out = (custom_trunk(module.fnet, img, cfg.fnet_norm)
                              if custom_trunk is not None else None)
                 return carry, module.fnet(img, trunk_out=trunk_out)
 
-            fnet_scan = nn.scan(fnet_one,
-                                variable_broadcast=("params", "batch_stats"),
-                                split_rngs={"params": False})
-            _, fmaps = fnet_scan(self, None, jnp.stack([image1, image2]))
-            fmap1, fmap2 = fmaps[0], fmaps[1]
+            with annotate("fnet"):
+                fnet_scan = nn.scan(
+                    fnet_one, variable_broadcast=("params", "batch_stats"),
+                    split_rngs={"params": False})
+                _, fmaps = fnet_scan(self, None, jnp.stack([image1, image2]))
+                fmap1, fmap2 = fmaps[0], fmaps[1]
         else:
-            levels, _ = self.cnet(image1)
-            both = self.fnet(jnp.concatenate([image1, image2], axis=0))
-            fmap1, fmap2 = jnp.split(both, 2, axis=0)
+            with annotate("cnet"):
+                levels, _ = self.cnet(image1)
+            with annotate("fnet"):
+                both = self.fnet(jnp.concatenate([image1, image2], axis=0))
+                fmap1, fmap2 = jnp.split(both, 2, axis=0)
 
         # levels[l] = [hidden_head, context_head] at level l (fine→coarse)
         net_list = [jnp.tanh(lv[0]) for lv in levels]
@@ -233,13 +243,18 @@ class RAFTStereo(nn.Module):
                 fmap1, fmap2, net_list, context, disp, iters, test_mode,
                 rows_mesh, rows_axis)
 
-        corr_fn = make_corr_fn(cfg, fmap1, fmap2)
+        with annotate("corr_pyramid"):
+            corr_fn = make_corr_fn(cfg, fmap1, fmap2)
         grid_x = coords_grid_x(b, h8, w8, dtype=jnp.float32)
 
         n = cfg.n_gru_layers
 
         def gru_step(module, net_list, disp):
             """One refinement iteration (reference: core/raft_stereo.py:108-123)."""
+            with annotate("gru_iter"):
+                return _gru_step_body(module, net_list, disp)
+
+        def _gru_step_body(module, net_list, disp):
             disp = jax.lax.stop_gradient(disp)
             # Named so the remat policy below can SAVE this lookup's output:
             # the backward then reuses it instead of re-running the Pallas
@@ -316,9 +331,10 @@ class RAFTStereo(nn.Module):
 
     def _upsample(self, disp: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
         """Convex-upsample a (B,h,w) disparity to full resolution (B,H,W)."""
-        up = convex_upsample(disp[..., None], mask.astype(jnp.float32),
-                             self.config.downsample_factor)
-        return up[..., 0]
+        with annotate("upsample"):
+            up = convex_upsample(disp[..., None], mask.astype(jnp.float32),
+                                 self.config.downsample_factor)
+            return up[..., 0]
 
 
 def create_model(cfg: RaftStereoConfig):

@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from raft_stereo_tpu.config import RaftStereoConfig
-from raft_stereo_tpu.models.extractor import conv
+from raft_stereo_tpu.models.extractor import conv, kaiming_out
 from raft_stereo_tpu.ops.pooling import pool2x
 from raft_stereo_tpu.ops.resize import interp_like
 
@@ -35,6 +35,26 @@ class FlowHead(nn.Module):
         return conv(self.output_dim, 3, 1, dtype=self.dtype, name="conv2")(y)
 
 
+class _GateConvParams(nn.Module):
+    """Parameter twin of one Flax gate conv: declares exactly the param tree
+    ``nn.Conv`` builds (HWIO ``kernel`` + ``bias``, same initializers, fp32)
+    and hands the raw arrays to the fused kernel instead of running the
+    conv.  Named ``convzr``/``convq`` it is checkpoint-interchangeable with
+    the Flax path — same pytree paths, shapes, and init values."""
+
+    features: int
+    in_features: int
+    kernel_size: int
+
+    @nn.compact
+    def __call__(self):
+        k = self.kernel_size
+        kernel = self.param("kernel", kaiming_out,
+                            (k, k, self.in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return kernel, bias
+
+
 class ConvGRU(nn.Module):
     """ConvGRU with pre-computed context biases (reference: core/update.py:16-32).
 
@@ -43,11 +63,21 @@ class ConvGRU(nn.Module):
     conv dispatches in the scan body's hottest block for identical math (the
     reference keeps two convs, core/update.py:18-19; the torch importer
     concatenates their weights into ``convzr`` so checkpoints stay
-    compatible).  q cannot join: its input ``[r*h, x]`` depends on r."""
+    compatible).  q cannot join: its input ``[r*h, x]`` depends on r.
+
+    ``fused`` (= config.fused_gru) routes the whole gate pipeline — both
+    convs and the r coupling — through the Pallas kernel
+    (kernels/gru_fused.py) when the backend supports it and the level's
+    working set fits VMEM; the pointwise tail stays in XLA so the
+    "gru_gates" remat tag keeps its meaning (saved gates ⇒ the backward
+    recompute is pointwise-only).  Dispatch is per level at trace time;
+    init always takes the Flax branch so the parameter tree is created by
+    ``nn.Conv`` regardless of mode."""
 
     hidden_dim: int
     kernel_size: int = 3
     dtype: Optional[Any] = None
+    fused: str = "off"   # config.fused_gru: "auto" | "on" | "off"
 
     @nn.compact
     def __call__(self, h, context, *x_list):
@@ -55,8 +85,32 @@ class ConvGRU(nn.Module):
 
         cz, cr, cq = context
         x = jnp.concatenate(x_list, axis=-1)
-        hx = jnp.concatenate([h, x], axis=-1)
         k = self.kernel_size
+        hd = self.hidden_dim
+
+        use_fused = False
+        if self.fused != "off" and not self.is_initializing():
+            from raft_stereo_tpu.kernels.gru_fused import gru_fused_should_use
+            use_fused = gru_fused_should_use(
+                self.fused, kernel_size=k, w=h.shape[2],
+                cin=h.shape[-1] + x.shape[-1], ch=hd,
+                itemsize=h.dtype.itemsize)
+        if use_fused:
+            from raft_stereo_tpu.kernels.gru_fused import gru_gates_fused
+            cin = h.shape[-1] + x.shape[-1]
+            wzr, bzr = _GateConvParams(2 * hd, cin, k, name="convzr")()
+            wq, bq = _GateConvParams(hd, cin, k, name="convq")()
+            zr, qpre = gru_gates_fused(h, x, cr, wzr, bzr, wq, bq)
+            # Same remat tags at the same sites as the Flax branch below —
+            # tests/test_remat_names.py pins that every config.remat_save
+            # name survives in the traced graph on both paths.
+            zr = checkpoint_name(zr, "gru_gates")
+            qpre = checkpoint_name(qpre, "gru_gates")
+            z = nn.sigmoid(zr[..., :hd] + cz)
+            q = nn.tanh(qpre + cq)
+            return (1 - z) * h + z * q
+
+        hx = jnp.concatenate([h, x], axis=-1)
         # Pre-activation gate convs carry a remat name: with "gru_gates" in
         # config.remat_save the backward reuses them instead of re-running
         # the scan body's two largest convs (see the remat policy in
@@ -120,26 +174,34 @@ class BasicMultiUpdateBlock(nn.Module):
         interp = self.interp_fn or interp_like
 
         # GRU input dims mirror reference core/update.py:104-106 under our
-        # fine→coarse indexing.
+        # fine→coarse indexing.  Every level inherits config.fused_gru; the
+        # fused-vs-Flax dispatch itself happens per level inside ConvGRU
+        # (per-level W/Cin decide the VMEM fit).
+        fused = cfg.fused_gru
         if iter_coarse and n == 3:
-            net[2] = ConvGRU(hd[2], dtype=self.dtype, name="gru32")(
+            net[2] = ConvGRU(hd[2], dtype=self.dtype, fused=fused,
+                             name="gru32")(
                 net[2], context[2], pool2x(net[1]))
         if iter_mid and n >= 2:
             if n > 2:
-                net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
+                net[1] = ConvGRU(hd[1], dtype=self.dtype, fused=fused,
+                                 name="gru16")(
                     net[1], context[1], pool2x(net[0]),
                     interp(net[2], net[1]))
             else:
-                net[1] = ConvGRU(hd[1], dtype=self.dtype, name="gru16")(
+                net[1] = ConvGRU(hd[1], dtype=self.dtype, fused=fused,
+                                 name="gru16")(
                     net[1], context[1], pool2x(net[0]))
         if iter_fine:
             motion = BasicMotionEncoder(dtype=self.dtype, name="encoder")(
                 flow, corr)
             if n > 1:
-                net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
+                net[0] = ConvGRU(hd[0], dtype=self.dtype, fused=fused,
+                                 name="gru08")(
                     net[0], context[0], motion, interp(net[1], net[0]))
             else:
-                net[0] = ConvGRU(hd[0], dtype=self.dtype, name="gru08")(
+                net[0] = ConvGRU(hd[0], dtype=self.dtype, fused=fused,
+                                 name="gru08")(
                     net[0], context[0], motion)
 
         if not update:

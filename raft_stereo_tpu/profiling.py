@@ -36,18 +36,32 @@ def trace(log_dir: str = "profiles", host_tracer_level: int = 2):
     """Capture a profiler trace into ``log_dir`` for the duration of the
     block (TensorBoard ``profile`` plugin or Perfetto reads it)."""
     os.makedirs(log_dir, exist_ok=True)
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(log_dir, profiler_options=options)
+    if hasattr(jax.profiler, "ProfileOptions"):
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(log_dir, profiler_options=options)
+    else:  # older jax without per-trace options
+        jax.profiler.start_trace(log_dir)
     try:
         yield log_dir
     finally:
         jax.profiler.stop_trace()
 
 
+@contextlib.contextmanager
 def annotate(name: str):
-    """Named span context; nests, shows on the host timeline in traces."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named span context; nests.
+
+    Two effects, one name: a host-timeline span (``TraceAnnotation``) for
+    code that RUNS inside the block, and — because model code is traced,
+    not run — an XLA op-name scope (``jax.named_scope``) so every op staged
+    out inside the block carries ``name/`` in its metadata.  Device traces
+    then break out the same phases the bench reports: the model wraps
+    ``fnet``/``cnet``/``corr_pyramid``/``gru_iter``/``upsample``
+    (models/raft_stereo.py) and bench.py's ``realtime_phase_split`` line
+    reports encoder-vs-GRU wall time."""
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> dict:

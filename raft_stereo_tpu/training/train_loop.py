@@ -48,10 +48,10 @@ log = logging.getLogger(__name__)
 # weight-shaping architecture fields (hidden_dims, n_gru_layers,
 # corr_levels, ...), which is the point of a warm start.
 _EXEC_CONFIG_FIELDS = (
-    "corr_backend", "slow_fast_gru", "mixed_precision", "corr_fp32",
-    "banded_encoder", "corr_w2_shards", "rows_shards", "rows_gru",
-    "rows_gru_halo", "remat_gru", "remat_save", "sequential_fnet_pixels",
-    "band_rows")
+    "corr_backend", "fused_gru", "slow_fast_gru", "mixed_precision",
+    "corr_fp32", "banded_encoder", "corr_w2_shards", "rows_shards",
+    "rows_gru", "rows_gru_halo", "remat_gru", "remat_save",
+    "sequential_fnet_pixels", "band_rows")
 
 
 def merge_warm_start_config(caller_cfg: RaftStereoConfig,

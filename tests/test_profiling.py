@@ -59,3 +59,49 @@ def test_trace_writes_profile(tmp_path):
 def test_device_memory_stats_dict():
     stats = profiling.device_memory_stats()
     assert isinstance(stats, dict)  # CPU backend may legitimately report {}
+
+
+def test_annotate_names_traced_ops():
+    """annotate() is also an XLA op-name scope: ops staged inside the block
+    carry the phase name, so device traces break out the model's phases
+    (fnet/cnet/corr_pyramid/gru_iter/upsample)."""
+    def f(x):
+        with profiling.annotate("myphase"):
+            return x * 2.0
+
+    ir = jax.jit(f).lower(jnp.ones((4,))).compiler_ir("stablehlo")
+    # scope names live in the MLIR location info, which XLA turns into the
+    # op metadata that device traces display
+    assert "myphase" in ir.operation.get_asm(enable_debug_info=True)
+
+
+def test_bench_phase_split_math():
+    """bench.py's realtime_phase_split line: differencing the 7-iter and
+    1-iter forwards attributes per-GRU-iter vs fixed (encoder+) time."""
+    import bench
+
+    # synthetic: 0.9 ms fixed + 1.1 ms/iter
+    split = bench.phase_split(t_iters_s=0.9e-3 + 7 * 1.1e-3,
+                              t_one_iter_s=0.9e-3 + 1.1e-3, iters=7)
+    assert split["metric"] == "realtime_phase_split"
+    assert split["per_gru_iter_ms"] == pytest.approx(1.1, abs=1e-3)
+    assert split["encoder_and_fixed_ms"] == pytest.approx(0.9, abs=1e-3)
+    assert split["gru_share_at_7_iters"] == pytest.approx(
+        7 * 1.1 / (0.9 + 7 * 1.1), abs=1e-3)
+
+
+def test_bench_regression_warnings():
+    """The warn-on-regression comparison against BASELINE.json's published
+    phase split: quiet within the noise band, loud past it."""
+    import bench
+
+    good = bench.phase_split(t_iters_s=0.9e-3 + 7 * 0.5e-3,
+                             t_one_iter_s=0.9e-3 + 0.5e-3, iters=7)
+    assert bench.check_regression(good, fps=150.0) == []
+
+    bad = bench.phase_split(t_iters_s=0.9e-3 + 7 * 5.0e-3,
+                            t_one_iter_s=0.9e-3 + 5.0e-3, iters=7)
+    warns = bench.check_regression(bad, fps=20.0)
+    kinds = " ".join(w["warning"] for w in warns)
+    assert "per_gru_iter_ms" in kinds
+    assert "north-star" in kinds

@@ -1,0 +1,86 @@
+"""Every name in config.remat_save must exist as a checkpoint_name tag in
+the traced train-mode graph.
+
+The remat policy is ``save_only_these_names(*cfg.remat_save)``: a tag that
+silently disappears (e.g. renamed, or dropped when a computation moves into
+a fused kernel) turns the save-policy into save-nothing — training still
+produces correct numbers but the backward recomputes everything, blowing up
+step time/memory with no error anywhere.  This test walks the traced
+jaxpr for ``name`` primitives and pins the full tag set on both the Flax
+and fused-GRU paths.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+ALL_SAVE_NAMES = ("corr_lookup", "gru_gates", "motion_features")
+
+
+def _collect_checkpoint_names(jaxpr) -> set:
+    """All checkpoint_name tags (``name`` primitive params) in a jaxpr,
+    recursing into every sub-jaxpr (scan/remat/custom-vjp bodies)."""
+    names = set()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "name":
+                names.add(eqn.params["name"])
+            for sub in jax.core.jaxprs_in_params(eqn.params):
+                walk(sub)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return names
+
+
+def _traced_names(cfg) -> set:
+    model = RAFTStereo(cfg)
+    img = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), img, img, iters=1, test_mode=True)
+    jaxpr = jax.make_jaxpr(
+        lambda v_, a, b: model.apply(v_, a, b, iters=2))(v, img, img)
+    return _collect_checkpoint_names(jaxpr)
+
+
+def test_remat_save_names_present_flax_path():
+    cfg = RaftStereoConfig(hidden_dims=(16, 16), n_gru_layers=2,
+                           fnet_dim=32, corr_levels=2, corr_radius=3,
+                           fused_gru="off", remat_save=ALL_SAVE_NAMES)
+    names = _traced_names(cfg)
+    missing = set(cfg.remat_save) - names
+    assert not missing, (
+        f"remat_save names {sorted(missing)} are not tagged anywhere in the "
+        f"train-mode graph (found {sorted(names)}) — the save policy for "
+        "them is silently a no-op")
+
+
+def test_remat_save_names_present_fused_path():
+    """The fused ConvGRU kernel must keep tagging its gate outputs: the
+    "gru_gates" site moved from the Flax conv outputs onto the kernel's
+    (zr, qpre) outputs and must not be lost."""
+    from raft_stereo_tpu.kernels import corr_lookup
+
+    corr_lookup._interpret_override = True
+    try:
+        cfg = RaftStereoConfig(hidden_dims=(16, 16), n_gru_layers=2,
+                               fnet_dim=32, corr_levels=2, corr_radius=3,
+                               fused_gru="on", remat_save=ALL_SAVE_NAMES)
+        names = _traced_names(cfg)
+    finally:
+        corr_lookup._interpret_override = None
+    missing = set(cfg.remat_save) - names
+    assert not missing, (
+        f"remat_save names {sorted(missing)} vanished from the fused-GRU "
+        f"train-mode graph (found {sorted(names)})")
+
+
+def test_unknown_remat_name_still_rejected():
+    """Config-level guard stays intact (complements the graph-level pin)."""
+    with pytest.raises(ValueError, match="remat_save"):
+        RaftStereoConfig(remat_save=("gru_gates", "renamed_tag"))
