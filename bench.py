@@ -111,6 +111,7 @@ def check_regression(split: dict, fps: float) -> list:
 def main():
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.telemetry.events import bench_record
 
     cfg = RaftStereoConfig.realtime()
     model = RAFTStereo(cfg)
@@ -129,12 +130,14 @@ def main():
                                      BENCH_ITERS)
     t_one = _seconds_per_forward(model, variables, img1, img2, 1)
     fps = 1.0 / per_image
-    print(json.dumps({
+    # Shared versioned header (telemetry/events.py): schema_version + the
+    # run's device topology/timestamp ride the primary record.
+    print(json.dumps(bench_record({
         "metric": "realtime_model_inference_fps_kitti_res",
         "value": round(fps, 2),
         "unit": "frames/s",
         "vs_baseline": round(fps / BASELINE_FPS, 3),
-    }))
+    })))
     split = phase_split(per_image, t_one, BENCH_ITERS)
     split["fused_gru"] = cfg.fused_gru
     print(json.dumps(split))

@@ -46,9 +46,16 @@ def main():
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
     from raft_stereo_tpu.profiling import chained_seconds_per_call
+    from raft_stereo_tpu.telemetry.events import bench_record
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    # Shared versioned run header (telemetry/events.py); the per-(backend,
+    # size) lines below are rows under it.
+    print(json.dumps(bench_record(
+        {"metric": "fullres_inference_run", "banded": args.banded,
+         "iters": ITERS, "sizes": [f"{h}x{w}" for h, w in SIZES]})))
 
     rng = np.random.default_rng(0)
     results = []

@@ -147,11 +147,18 @@ def main():
     args = ap.parse_args()
 
     from raft_stereo_tpu import native
+    from raft_stereo_tpu.telemetry.events import bench_record
 
     root = args.root or tempfile.mkdtemp(prefix="loaderbench_")
     if not args.root:
         build_tree(root, args.pairs)
 
+    # Shared versioned run header (telemetry/events.py); the per-config
+    # lines below are rows under it.
+    print(json.dumps(bench_record(
+        {"metric": "loader_bench_run", "pairs": args.pairs,
+         "batches": args.batches, "workers": args.workers,
+         "device": args.device})))
     print(json.dumps({"metric": "loader_stage_breakdown_ms",
                       **stage_breakdown(root), "unit": "ms/sample"}))
 

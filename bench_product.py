@@ -145,9 +145,11 @@ def main():
     # can come out ~0 or negative — report no ceiling instead of nonsense.
     transfer_floor_s = (up_ms + down_ms) / 1e3
     transfer_floor16_s = (up_ms + down16_ms) / 1e3
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
     has_floor = transfer_floor_s > 1e-4
     has_floor16 = transfer_floor16_s > 1e-4
-    rec = {
+    rec = bench_record({
         "metric": "product_path_fps_kitti",
         "value": round(fps_product, 2),
         "unit": "frames/s (validate_kitti end-to-end, 375x1242)",
@@ -173,10 +175,9 @@ def main():
         "tunnel_fetch_flow_ms": round(down_ms, 1),
         "kitti_epe_random_weights": round(res["kitti-epe"], 2),
         "n_timed": N_IMAGES - 50,  # FpsProtocol times images 51..N
-    }
+    })
     print(json.dumps(rec))
-    with open(os.path.join(_REPO, "PRODUCT_r05.json"), "w") as f:
-        f.write(json.dumps(rec) + "\n")
+    write_record(os.path.join(_REPO, "PRODUCT_r05.json"), rec)
 
 
 if __name__ == "__main__":

@@ -165,8 +165,10 @@ def main():
                 n_requests=n_req, max_queue=16, rng=rng, **s))
             print(json.dumps(runs[-1]), flush=True)
 
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
     best = max(runs, key=lambda r: r["throughput_hz"])
-    rec = {
+    rec = bench_record({
         "metric": "serve_throughput_hz",
         "value": best["throughput_hz"],
         "unit": f"requests/s (serving path, {hw[0]}x{hw[1]}, iters={iters})",
@@ -176,10 +178,9 @@ def main():
         "best_setting": {k: best[k] for k in
                          ("max_batch", "batch_mode", "offered_hz")},
         "runs": runs,
-    }
+    })
     print(json.dumps(rec))
-    with open(os.path.join(_REPO, OUT), "w") as f:
-        f.write(json.dumps(rec, indent=1) + "\n")
+    write_record(os.path.join(_REPO, OUT), rec, indent=1)
 
 
 if __name__ == "__main__":

@@ -79,6 +79,7 @@ def main():
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
     from raft_stereo_tpu.profiling import (chained_seconds_per_call,
                                            device_memory_stats, trace)
+    from raft_stereo_tpu.telemetry.events import bench_record
     from raft_stereo_tpu.training.state import create_train_state
     from raft_stereo_tpu.training.step import train_step
 
@@ -171,7 +172,7 @@ def main():
     attained_gbps = 2 * v.nbytes / t_of(probe_ew, v) / 1e9
     mfu_attained = achieved_tflops / attained_tflops
 
-    print(json.dumps({
+    print(json.dumps(bench_record({
         "metric": "sceneflow_train_step_time",
         "value": round(step_s, 4),
         "unit": "s/step (batch 8, 320x720, 22 iters, bf16)",
@@ -185,7 +186,7 @@ def main():
         "mfu_vs_attained": round(mfu_attained, 3),
         "device_kind": kind,
         "peak_hbm_gib": round(peak_hbm_gib, 2),
-    }))
+    })))
 
 
 if __name__ == "__main__":

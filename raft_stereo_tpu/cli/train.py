@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from raft_stereo_tpu.cli import common
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
@@ -49,6 +50,7 @@ def configs_from_args(args):
         validation_frequency=args.validation_frequency,
         seed=args.seed,
         data_parallel=args.data_parallel,
+        gru_telemetry=args.gru_telemetry,
     )
     return model_cfg, train_cfg
 
@@ -110,6 +112,23 @@ def build_parser() -> argparse.ArgumentParser:
         return n
     p.add_argument("--data_parallel", type=_nonneg_int, default=0,
                    help="devices along the data axis (0 = all)")
+    # Observability (telemetry/): off by default — with no --metrics_port
+    # and no --event_log the loop runs the exact uninstrumented path.
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve GET /metrics (Prometheus), GET /healthz "
+                        "(last-step age), POST /debug/trace (bounded "
+                        "profiler window) on this port; 0 = ephemeral")
+    p.add_argument("--metrics_host", default="127.0.0.1")
+    p.add_argument("--event_log", default=None,
+                   help="append structured JSONL run events (run-start "
+                        "config snapshot, step stats, validation, "
+                        "checkpoint/preemption, compile events) to this "
+                        "file; defaults to <log_dir>/events.jsonl when "
+                        "--metrics_port is set")
+    p.add_argument("--gru_telemetry", action="store_true",
+                   help="also record per-iteration GRU disparity-delta "
+                        "magnitudes (convergence curve; small on-device "
+                        "reduction per iteration)")
     common.add_arch_overrides(p)
     return p
 
@@ -134,12 +153,44 @@ def main(argv=None):
             datasets=tuple(args.validate_datasets),
             max_images=args.validate_max_images)
 
+    # Opt-in observability: instruments + event log + scrape endpoint
+    # (docs/architecture.md §Observability).  Built before train() so the
+    # endpoint is already answering /healthz while compilation runs.
+    telemetry = None
+    server = None
+    events = None
+    event_log_path = args.event_log
+    if args.metrics_port is not None and event_log_path is None:
+        event_log_path = os.path.join(args.log_dir, "events.jsonl")
+    if args.metrics_port is not None or event_log_path is not None:
+        from raft_stereo_tpu.telemetry import (EventLog, TelemetryHTTPServer,
+                                               TrainTelemetry)
+        if event_log_path is not None:
+            events = EventLog(event_log_path)
+        telemetry = TrainTelemetry(events=events)
+        if args.metrics_port is not None:
+            from raft_stereo_tpu.telemetry import TraceCapture
+            server = TelemetryHTTPServer(
+                telemetry.registry, telemetry.healthz,
+                host=args.metrics_host, port=args.metrics_port,
+                trace=TraceCapture(
+                    root=os.path.join(args.log_dir, "profiles"))).start()
+            log.info("training metrics endpoint on %s (GET /metrics, "
+                     "GET /healthz, POST /debug/trace)", server.url)
+
     from raft_stereo_tpu.training.train_loop import train
-    return train(model_cfg, train_cfg, name=args.name,
-                 data_root=args.data_root,
-                 checkpoint_dir=args.checkpoint_dir,
-                 restore=args.restore_ckpt, log_dir=args.log_dir,
-                 validate_fn=validate_fn, warm_start=args.warm_start)
+    try:
+        return train(model_cfg, train_cfg, name=args.name,
+                     data_root=args.data_root,
+                     checkpoint_dir=args.checkpoint_dir,
+                     restore=args.restore_ckpt, log_dir=args.log_dir,
+                     validate_fn=validate_fn, warm_start=args.warm_start,
+                     telemetry=telemetry)
+    finally:
+        if server is not None:
+            server.shutdown()
+        if events is not None:
+            events.close()
 
 
 if __name__ == "__main__":

@@ -309,6 +309,13 @@ class TrainConfig:
     # round 5).  Deterministic (fp16 rounding is a pure function); exact
     # resume stays bit-identical.  False = upload GT uncompressed.
     compact_upload: bool = True
+    # GRU convergence telemetry (telemetry/train_metrics.py): the step also
+    # returns per-iteration mean |disparity update| magnitudes, so the
+    # observed convergence curve — not the paper's fixed 7/32 — drives
+    # iteration-count choices.  The (train_iters-1,) vector rides the
+    # existing buffered metric fetch (no extra device sync); off by default
+    # because it adds a small on-device reduction per iteration.
+    gru_telemetry: bool = False
     # Runtime
     validation_frequency: int = 10_000
     seed: int = 1234

@@ -19,6 +19,10 @@ Endpoints:
   503 (draining), 504 (deadline passed in queue), 400 (malformed input).
 * ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
 * ``GET /healthz`` — one JSON line: status, queue depth, device count.
+* ``POST /debug/trace`` — bounded on-demand profiler window on the live
+  serving process (telemetry/trace.py); optional JSON body
+  ``{"duration_ms": N}``; replies with the trace directory, 409 while a
+  window is already open.
 
 ``ThreadingHTTPServer`` gives one Python thread per connection; the real
 concurrency limit is the service's bounded queue, which is the point —
@@ -38,6 +42,8 @@ import numpy as np
 
 from raft_stereo_tpu.serving.batcher import DeadlineExceeded, Overloaded
 from raft_stereo_tpu.serving.service import StereoService
+from raft_stereo_tpu.telemetry.http import handle_trace_post
+from raft_stereo_tpu.telemetry.trace import TraceCapture
 
 log = logging.getLogger(__name__)
 
@@ -78,9 +84,11 @@ def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
     raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
 
 
-def make_handler(service: StereoService):
+def make_handler(service: StereoService,
+                 trace: Optional[TraceCapture] = None):
     """Handler class closed over ``service`` (BaseHTTPRequestHandler is
     instantiated per request by the server, so state rides the closure)."""
+    trace = trace if trace is not None else TraceCapture()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -119,6 +127,9 @@ def make_handler(service: StereoService):
 
         def do_POST(self):
             url = urlparse(self.path)
+            if url.path == "/debug/trace":
+                handle_trace_post(self, trace, self._reply_json)
+                return
             if url.path != "/v1/disparity":
                 self._reply_json(404, {"error": f"no route {url.path!r}"})
                 return
@@ -173,8 +184,9 @@ class StereoHTTPServer:
     def __init__(self, service: StereoService, host: str = "127.0.0.1",
                  port: int = 8551):
         self.service = service
+        self.trace = TraceCapture()
         self.server = ThreadingHTTPServer((host, port),
-                                          make_handler(service))
+                                          make_handler(service, self.trace))
         self._thread = None
 
     @property
@@ -200,5 +212,6 @@ class StereoHTTPServer:
     def shutdown(self):
         self.server.shutdown()
         self.server.server_close()
+        self.trace.stop()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

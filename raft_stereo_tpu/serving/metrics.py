@@ -1,199 +1,23 @@
-"""Serving observability: counters, gauges, histograms, text exposition.
+"""Serving observability: the serving instrument set over the SHARED
+registry (telemetry/registry.py).
 
-The training side already has a metrics pipeline (training/logger.py buffers
-device scalars into tensorboard); serving needs the opposite shape — host-side
-instruments updated from many threads and readable at any instant by a
-scrape.  Everything here is stdlib + NumPy: a ``MetricsRegistry`` holds named
-instruments, and ``render_text()`` emits the Prometheus text exposition
-format so the stdlib HTTP endpoint (serving/http.py ``GET /metrics``) is
-directly scrapable without any client library.
-
-Histograms keep BOTH cumulative buckets (the scrape surface) and a bounded
-reservoir of recent samples, because the bench and the drain report want
-honest p50/p95/p99 — bucket interpolation at three-decade latency spreads
-would be fiction.  The reservoir is a ring buffer: O(1) per observe, the
-percentiles describe the most recent ``reservoir`` samples.
+The Counter/Gauge/Histogram/MetricsRegistry implementations started life in
+this module; PR 3 promoted them to ``raft_stereo_tpu.telemetry.registry`` as
+the single implementation the training runtime and bench tooling share, and
+this module re-exports them so every existing ``serving.metrics`` import
+keeps working unchanged.  ``ServingMetrics`` — the serving subsystem's
+standard instrument set — still lives here.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Optional
 
-import numpy as np
+from raft_stereo_tpu.telemetry.registry import (  # noqa: F401 — re-exports
+    DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
 
-# Seconds-scale latency buckets: 0.5 ms .. 30 s, roughly 1-2-5 per decade.
-# Wide on purpose — the same instrument serves a local CPU fallback
-# (micro-seconds of queue wait) and a remote-tunneled device (hundreds of ms
-# per forward).
-DEFAULT_LATENCY_BUCKETS = (
-    0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05,
-    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
-
-
-class Counter:
-    """Monotonic counter (thread-safe)."""
-
-    def __init__(self, name: str, help: str = ""):
-        self.name, self.help = name, help
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
-
-    def render(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} counter",
-                f"{self.name} {self.value}"]
-
-
-class Gauge:
-    """Instant value (thread-safe); ``set``/``inc``/``dec``."""
-
-    def __init__(self, name: str, help: str = ""):
-        self.name, self.help = name, help
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = float(v)
-
-    def inc(self, n: float = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    def dec(self, n: float = 1) -> None:
-        self.inc(-n)
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-    def render(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} gauge",
-                f"{self.name} {self.value:g}"]
-
-
-class Histogram:
-    """Cumulative-bucket histogram + bounded reservoir for percentiles.
-
-    ``observe`` is O(1); ``percentile`` sorts the reservoir on demand
-    (scrape/report-time cost, not request-time).
-    """
-
-    def __init__(self, name: str, help: str = "",
-                 buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
-                 reservoir: int = 4096):
-        self.name, self.help = name, help
-        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
-        self._sum = 0.0
-        self._count = 0
-        self._samples = np.zeros(max(1, reservoir), np.float64)
-        self._next = 0  # ring-buffer write cursor
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        with self._lock:
-            i = 0
-            for i, edge in enumerate(self.buckets):
-                if v <= edge:
-                    break
-            else:
-                i = len(self.buckets)
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-            self._samples[self._next % len(self._samples)] = v
-            self._next += 1
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    @property
-    def sum(self) -> float:
-        with self._lock:
-            return self._sum
-
-    def mean(self) -> float:
-        with self._lock:
-            return self._sum / self._count if self._count else 0.0
-
-    def percentile(self, q: float) -> float:
-        """q in [0, 100] over the reservoir (recent samples); 0.0 if empty."""
-        with self._lock:
-            n = min(self._next, len(self._samples))
-            if not n:
-                return 0.0
-            return float(np.percentile(self._samples[:n], q))
-
-    def percentiles(self, qs=(50, 95, 99)) -> Dict[str, float]:
-        return {f"p{q:g}": self.percentile(q) for q in qs}
-
-    def render(self) -> List[str]:
-        with self._lock:
-            counts, total, s = list(self._counts), self._count, self._sum
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        cum = 0
-        for edge, c in zip(self.buckets, counts):
-            cum += c
-            lines.append(f'{self.name}_bucket{{le="{edge:g}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {s:g}")
-        lines.append(f"{self.name}_count {total}")
-        return lines
-
-
-class MetricsRegistry:
-    """Named instruments + the text exposition the HTTP endpoint serves."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._instruments: Dict[str, object] = {}
-
-    def _register(self, inst):
-        with self._lock:
-            if inst.name in self._instruments:
-                raise ValueError(f"metric {inst.name!r} already registered")
-            self._instruments[inst.name] = inst
-        return inst
-
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._register(Counter(name, help))
-
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._register(Gauge(name, help))
-
-    def histogram(self, name: str, help: str = "",
-                  buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
-                  reservoir: int = 4096) -> Histogram:
-        return self._register(Histogram(name, help, buckets, reservoir))
-
-    def get(self, name: str):
-        with self._lock:
-            return self._instruments.get(name)
-
-    def render_text(self) -> str:
-        with self._lock:
-            insts = list(self._instruments.values())
-        lines: List[str] = []
-        for inst in insts:
-            lines.extend(inst.render())
-        return "\n".join(lines) + "\n"
+__all__ = ["DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "ServingMetrics"]
 
 
 class ServingMetrics:

@@ -1,0 +1,20 @@
+"""Unified observability layer: one instrument registry, one event schema,
+one HTTP surface across training, serving, and the bench tooling.
+See docs/architecture.md §Observability."""
+
+from raft_stereo_tpu.telemetry.events import (SCHEMA_VERSION, EventLog,
+                                              bench_record, replay,
+                                              run_metadata, write_record)
+from raft_stereo_tpu.telemetry.http import TelemetryHTTPServer
+from raft_stereo_tpu.telemetry.registry import (DEFAULT_LATENCY_BUCKETS,
+                                                Counter, Gauge, Histogram,
+                                                MetricsRegistry)
+from raft_stereo_tpu.telemetry.trace import (TraceBusy, TraceCapture)
+from raft_stereo_tpu.telemetry.train_metrics import TrainTelemetry
+
+__all__ = [
+    "SCHEMA_VERSION", "EventLog", "bench_record", "replay", "run_metadata",
+    "write_record", "TelemetryHTTPServer", "DEFAULT_LATENCY_BUCKETS",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "TraceBusy",
+    "TraceCapture", "TrainTelemetry",
+]

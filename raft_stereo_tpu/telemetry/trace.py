@@ -1,0 +1,78 @@
+"""On-demand, bounded profiler trace capture for HTTP endpoints.
+
+``POST /debug/trace`` on the training (telemetry/http.py) and serving
+(serving/http.py) endpoints opens a ``profiling.trace()`` window on the
+LIVE process — the running train loop or the serving worker pool — and
+returns the trace directory.  That turns "re-run the bench with --trace"
+into "curl the process that is already misbehaving".
+
+The window is strictly bounded: the JAX profiler is process-global, so at
+most one capture runs at a time (a second request gets ``TraceBusy`` →
+HTTP 409) and a timer thread stops the trace after ``duration_ms``
+(clamped to ``MAX_TRACE_MS``) even if nobody ever asks again.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+DEFAULT_TRACE_MS = 1000.0
+MAX_TRACE_MS = 60_000.0
+
+
+class TraceBusy(RuntimeError):
+    """A capture is already open (the JAX profiler is process-global)."""
+
+
+class TraceCapture:
+    """Serializes bounded ``profiling.trace()`` windows under ``root``."""
+
+    def __init__(self, root: str = "profiles"):
+        self.root = root
+        self._lock = threading.Lock()
+        self._open: Optional[object] = None  # entered trace context manager
+        self._timer: Optional[threading.Timer] = None
+        self._n = 0
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._open is not None
+
+    def start(self, duration_ms: Optional[float] = None) -> Dict[str, object]:
+        """Open a capture window; returns ``{"trace_dir", "duration_ms"}``.
+        Raises ``TraceBusy`` while a previous window is still open and
+        ``ValueError`` on a non-positive duration."""
+        from raft_stereo_tpu import profiling
+
+        ms = DEFAULT_TRACE_MS if duration_ms is None else float(duration_ms)
+        if ms <= 0:
+            raise ValueError(f"duration_ms={ms} must be > 0")
+        ms = min(ms, MAX_TRACE_MS)
+        with self._lock:
+            if self._open is not None:
+                raise TraceBusy("a trace capture is already running")
+            trace_dir = os.path.join(self.root, f"ondemand-{self._n}")
+            self._n += 1
+            cm = profiling.trace(trace_dir)
+            cm.__enter__()
+            self._open = cm
+            self._timer = threading.Timer(ms / 1e3, self.stop)
+            self._timer.daemon = True
+            self._timer.start()
+        return {"trace_dir": trace_dir, "duration_ms": ms}
+
+    def stop(self) -> bool:
+        """Close the window early (also the timer's callback); idempotent.
+        Returns True if a capture was actually closed."""
+        with self._lock:
+            cm, self._open = self._open, None
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+        if cm is None:
+            return False
+        cm.__exit__(None, None, None)
+        return True

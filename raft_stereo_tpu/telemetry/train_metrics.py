@@ -1,0 +1,340 @@
+"""Training-runtime instruments: step timing, memory, recompiles, GRU
+convergence — the training-side counterpart of ``serving.ServingMetrics``.
+
+The train loop is the repo's longest-lived process and was its least
+observable: a run that silently recompiles every step, stalls on the data
+loader, or drifts in step time looked identical to a healthy one until the
+bench was re-run by hand.  ``TrainTelemetry`` gives the loop the same
+scrapable surface the serving subsystem has had since round 6:
+
+* per-step wall-time split — data-wait (host loader + prefetch queue),
+  device-step (dispatch leg; advisory behind async dispatch, the same
+  caveat serving's ``serve_device_seconds`` documents), metric-drain (the
+  SUM_FREQ device fetch), checkpoint write;
+* host RSS + device live/peak bytes (``profiling.device_memory_stats``),
+  refreshed at the drain cadence — a host-side runtime query, not a device
+  fetch;
+* a recompile detector: ``jax.monitoring``'s per-compile
+  ``backend_compile_duration`` events are counted when they fire inside a
+  step-dispatch window AFTER step 1 completed (step-0 compilation is
+  expected, and host-side jnp work at the drain/checkpoint compiles tiny
+  programs legitimately; anything compiling inside a later step means a
+  shape or donation bug re-paying O(minutes) of XLA time), logged with the
+  offending batch shapes, and mirrored into the event log;
+* optional GRU convergence histograms (``observe_gru_deltas``): per-
+  iteration disparity-delta magnitudes from ``TrainConfig.gru_telemetry``,
+  so iteration-count choices follow an observed convergence curve instead
+  of the paper's fixed 7/32.
+
+EVERY method here is host-only: no ``device_get``, no ``float()`` on a
+device array.  The train loop guards each call behind ``telemetry is not
+None``, so the disabled (default) path is byte-identical to the old loop —
+tests/test_telemetry.py asserts the no-extra-fetch property.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+from raft_stereo_tpu.telemetry.events import EventLog
+from raft_stereo_tpu.telemetry.registry import (DEFAULT_LATENCY_BUCKETS,
+                                                MetricsRegistry)
+
+log = logging.getLogger(__name__)
+
+# Pixel-scale buckets for GRU disparity-delta magnitudes: sub-milli-px
+# (converged) up to tens of px (early iterations at SceneFlow disparities).
+GRU_DELTA_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2,
+                     0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0)
+
+# --- process-global compile-event dispatch ---------------------------------
+# jax.monitoring listeners cannot be unregistered portably, so we register
+# ONE module-level dispatcher lazily and point it at the active telemetry
+# instance; tests that create many TrainTelemetry objects don't accumulate
+# listeners, and a finished run simply detaches.
+_dispatch_lock = threading.Lock()
+_listener_registered = False
+_active_detector: Optional["TrainTelemetry"] = None
+
+# One logical jit compile fires several monitoring events (trace, lowering,
+# backend compile); we count only the backend-compile leg — the one that
+# pays XLA time.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def _on_monitoring_event(event: str, duration_secs: float, **kw) -> None:
+    det = _active_detector
+    if det is not None and event.endswith(_COMPILE_EVENT_SUFFIX):
+        det._on_compile(event, duration_secs)
+
+
+def _ensure_listener() -> bool:
+    global _listener_registered
+    with _dispatch_lock:
+        if _listener_registered:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_monitoring_event)
+        except Exception:  # pragma: no cover - jax without monitoring
+            return False
+        _listener_registered = True
+        return True
+
+
+def _set_active_detector(det: Optional["TrainTelemetry"]) -> None:
+    global _active_detector
+    with _dispatch_lock:
+        _active_detector = det
+
+
+def host_rss_bytes() -> int:
+    """Resident-set bytes of this process; 0 where /proc is unavailable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import resource  # page size without shelling out
+        return pages * resource.getpagesize()
+    except Exception:
+        try:
+            import resource
+            # ru_maxrss is KiB on Linux — peak, not current, but better
+            # than nothing on non-/proc platforms.
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # pragma: no cover - no resource module
+            return 0
+
+
+class TrainTelemetry:
+    """The training loop's instrument set + structured-event emitter.
+
+    Construct one per run (``cli/train.py --metrics_port``), hand it to
+    ``train(..., telemetry=...)``, and serve ``registry`` through a
+    ``telemetry.http.TelemetryHTTPServer``.  ``events`` is an optional
+    ``EventLog`` the lifecycle events mirror into.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 events: Optional[EventLog] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.events = events
+        self.steps = r.counter(
+            "train_steps_total", "optimization steps completed this run")
+        self.recompiles = r.counter(
+            "train_recompiles_total",
+            "XLA backend compilations observed AFTER step 1 (step-0 "
+            "compilation is expected; later ones mean shape churn)")
+        self.checkpoints = r.counter(
+            "train_checkpoints_total", "checkpoints written")
+        self.step_gauge = r.gauge(
+            "train_step", "current global step (includes restored steps)")
+        self.last_step_unix = r.gauge(
+            "train_last_step_unix_seconds",
+            "wall-clock time the last step completed (0 until step 1)")
+        self.images_per_s = r.gauge(
+            "train_images_per_s", "throughput over the last drain window")
+        self.host_rss = r.gauge(
+            "train_host_rss_bytes", "resident-set bytes of the train process")
+        self.device_bytes = r.gauge(
+            "train_device_bytes_in_use",
+            "live bytes on device 0 (0 where the backend reports none)")
+        self.device_peak_bytes = r.gauge(
+            "train_device_peak_bytes",
+            "peak bytes on device 0 (0 where the backend reports none)")
+        self.data_wait = r.histogram(
+            "train_data_wait_seconds",
+            "host wait for the next uploaded batch (loader + prefetch)")
+        self.step_time = r.histogram(
+            "train_step_seconds",
+            "step dispatch leg (advisory behind async dispatch — the drain "
+            "leg absorbs the device-bound tail, same caveat as "
+            "serve_device_seconds)")
+        self.drain_time = r.histogram(
+            "train_metric_drain_seconds",
+            "SUM_FREQ metric fetch: the one host<->device sync of the loop")
+        self.checkpoint_time = r.histogram(
+            "train_checkpoint_seconds", "checkpoint fetch + write",
+            buckets=DEFAULT_LATENCY_BUCKETS)
+        self.gru_delta = r.histogram(
+            "train_gru_delta_px",
+            "per-iteration |disparity update| means "
+            "(TrainConfig.gru_telemetry; empty when disabled)",
+            buckets=GRU_DELTA_BUCKETS)
+
+        self._lock = threading.Lock()
+        self._status = "starting"
+        self._total = 0
+        self._batch_size = 0
+        self._last_step_mono: Optional[float] = None
+        self._last_drain_mono = time.monotonic()
+        self._steps_at_last_drain = 0
+        self._shapes: Optional[Dict[str, str]] = None
+        self._step = 0
+        self._armed = False
+        self._in_step = False
+
+    # ----------------------------------------------------------- lifecycle
+    def run_start(self, model_cfg, train_cfg, start_step: int,
+                  name: str = "") -> None:
+        with self._lock:
+            self._status = "running"
+            self._step = start_step
+            self._total = int(getattr(train_cfg, "num_steps", 0))
+            self._batch_size = int(getattr(train_cfg, "batch_size", 0))
+            self._steps_at_last_drain = start_step
+            self._last_drain_mono = time.monotonic()
+        self.step_gauge.set(start_step)
+        if self.events is not None:
+            from raft_stereo_tpu.telemetry.events import run_metadata
+            self.events.emit(
+                "run_start", name=name, start_step=start_step,
+                run=run_metadata(),
+                model_config=_cfg_dict(model_cfg),
+                train_config=_cfg_dict(train_cfg))
+
+    def resumed(self, path: str, step: int) -> None:
+        if self.events is not None:
+            self.events.emit("resume", path=path, step=step)
+
+    def note_batch(self, batch) -> None:
+        """Shape/dtype summary of the batch about to step — metadata access
+        only; attributes recompiles to the shapes that caused them.  Also
+        opens the step-dispatch window the compile detector listens in:
+        host-side jnp work outside it (schedule eval at the drain,
+        checkpoint packing) compiles tiny programs legitimately and must
+        not read as train-step recompilation."""
+        try:
+            self._shapes = {k: f"{tuple(v.shape)}:{v.dtype}"
+                            for k, v in batch.items()}
+        except Exception:  # pragma: no cover - exotic batch container
+            self._shapes = None
+        self._in_step = True
+
+    def observe_step(self, step: int, data_wait_s: float,
+                     dispatch_s: float) -> None:
+        self._in_step = False
+        self.steps.inc()
+        self.step_gauge.set(step)
+        self.data_wait.observe(data_wait_s)
+        self.step_time.observe(dispatch_s)
+        now = time.time()
+        self.last_step_unix.set(now)
+        with self._lock:
+            self._step = step
+            self._last_step_mono = time.monotonic()
+        # Step-0 compilation is expected; arm the detector once the first
+        # step of THIS run has been dispatched.
+        if not self._armed:
+            self._armed = _ensure_listener()
+            if self._armed:
+                _set_active_detector(self)
+
+    def observe_drain(self, seconds: float, means: Dict[str, float],
+                      step: int, window: int) -> None:
+        """Called after each SUM_FREQ metric fetch with the window's mean
+        scalars; also the refresh point for throughput + memory gauges."""
+        self.drain_time.observe(seconds)
+        now = time.monotonic()
+        with self._lock:
+            elapsed = now - self._last_drain_mono
+            n_steps = step - self._steps_at_last_drain
+            self._last_drain_mono = now
+            self._steps_at_last_drain = step
+            batch = self._batch_size
+        if elapsed > 0 and n_steps > 0:
+            self.images_per_s.set(n_steps * max(1, batch) / elapsed)
+        self.host_rss.set(host_rss_bytes())
+        try:
+            from raft_stereo_tpu.profiling import device_memory_stats
+            stats = device_memory_stats()
+        except Exception:  # pragma: no cover - backend without stats
+            stats = {}
+        self.device_bytes.set(stats.get("bytes_in_use", 0))
+        self.device_peak_bytes.set(stats.get("peak_bytes_in_use", 0))
+        if self.events is not None:
+            self.events.emit(
+                "step_stats", step=step, window=window,
+                means={k: float(v) for k, v in means.items()},
+                images_per_s=self.images_per_s.value,
+                data_wait_ms_p50=self.data_wait.percentile(50) * 1e3,
+                step_ms_p50=self.step_time.percentile(50) * 1e3,
+                host_rss_bytes=int(self.host_rss.value),
+                device_bytes_in_use=int(self.device_bytes.value))
+
+    def observe_gru_deltas(self, deltas: Iterable[float]) -> None:
+        """Per-iteration mean |disparity update| magnitudes (px), already on
+        host — the drained ``gru_delta_px`` metric vector."""
+        for d in deltas:
+            self.gru_delta.observe(float(d))
+
+    def observe_checkpoint(self, seconds: float, path: str,
+                           step: int) -> None:
+        self.checkpoints.inc()
+        self.checkpoint_time.observe(seconds)
+        if self.events is not None:
+            self.events.emit("checkpoint", step=step, path=path,
+                             seconds=seconds)
+
+    def observe_validation(self, results: Dict[str, float],
+                           step: int) -> None:
+        if self.events is not None:
+            self.events.emit("validation", step=step,
+                             results={k: float(v)
+                                      for k, v in results.items()})
+
+    def stop_requested(self, signum: int) -> None:
+        with self._lock:
+            self._status = "stopping"
+        if self.events is not None:
+            self.events.emit("stop_requested", signal=int(signum),
+                             step=self._step)
+
+    def run_end(self, status: str, step: int) -> None:
+        with self._lock:
+            self._status = status
+        self.step_gauge.set(step)
+        if self._armed:
+            _set_active_detector(None)
+            self._armed = False
+        if self.events is not None:
+            self.events.emit("run_end", status=status, step=step)
+
+    # ------------------------------------------------------------- scrapes
+    def healthz(self) -> Dict[str, object]:
+        """The heartbeat ``GET /healthz`` serves: run status, step progress,
+        and the age of the last completed step."""
+        with self._lock:
+            last = self._last_step_mono
+            out: Dict[str, object] = {
+                "status": self._status,
+                "step": self._step,
+                "total_steps": self._total,
+            }
+        out["last_step_age_s"] = (round(time.monotonic() - last, 3)
+                                  if last is not None else None)
+        out["recompiles"] = self.recompiles.value
+        return out
+
+    # ------------------------------------------------- compile-event sink
+    def _on_compile(self, event: str, duration_secs: float) -> None:
+        if not self._in_step:
+            return
+        self.recompiles.inc()
+        shapes = self._shapes
+        log.warning(
+            "XLA recompilation after step 1 (step %d, %.2fs): batch shapes "
+            "%s — a changing shape or donation bug re-pays compile time "
+            "every occurrence", self._step, duration_secs, shapes)
+        if self.events is not None:
+            self.events.emit("compile", step=self._step, name=event,
+                             duration_s=duration_secs, batch_shapes=shapes)
+
+
+def _cfg_dict(cfg) -> Dict[str, object]:
+    to_dict = getattr(cfg, "to_dict", None)
+    return to_dict() if to_dict is not None else dict(vars(cfg))

@@ -24,6 +24,8 @@ class Logger:
                  enable_tensorboard: bool = True):
         self.total_steps = total_steps
         self.running: Dict[str, float] = {}
+        self.running_count = 0  # pushes since the last flush
+        self._last_lr = 0.0
         self.writer = None
         if enable_tensorboard:
             try:
@@ -33,17 +35,27 @@ class Logger:
                 log.warning("tensorboard unavailable; console logging only")
 
     def _flush(self, lr: float):
-        means = {k: v / SUM_FREQ for k, v in self.running.items()}
+        # Divide by the ACTUAL accumulated count, not SUM_FREQ: the
+        # ``% SUM_FREQ == SUM_FREQ - 1`` flush condition means the first
+        # window holds only SUM_FREQ-1 pushes (and the final partial drain
+        # at close() fewer still) — a constant divisor deflated those means.
+        n = self.running_count
+        if not n:
+            return
+        means = {k: v / n for k, v in self.running.items()}
         msg = ", ".join(f"{k} {v:.4f}" for k, v in sorted(means.items()))
         log.info("step %d, lr %.7f: %s", self.total_steps, lr, msg)
         if self.writer is not None:
             for k, v in means.items():
                 self.writer.add_scalar(k, v, self.total_steps)
         self.running = {}
+        self.running_count = 0
 
     def push(self, metrics: Dict[str, float], lr: float = 0.0):
         """Accumulate one step's metrics; flush every SUM_FREQ steps."""
         self.total_steps += 1
+        self.running_count += 1
+        self._last_lr = lr
         for k, v in metrics.items():
             self.running[k] = self.running.get(k, 0.0) + float(v)
         if self.writer is not None:
@@ -61,5 +73,18 @@ class Logger:
                 self.writer.add_scalar(k, float(v), self.total_steps)
 
     def close(self):
+        # Drain a partial window first so a run that stops between flush
+        # boundaries (preemption, crash, short test run) keeps its tail.
+        if self.running_count:
+            self._flush(getattr(self, "_last_lr", 0.0))
         if self.writer is not None:
             self.writer.close()
+            self.writer = None
+
+    def __enter__(self) -> "Logger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        """Context manager: the TensorBoard writer closes on every exit
+        path (train_loop.py wraps the whole loop in ``with Logger(...)``)."""
+        self.close()

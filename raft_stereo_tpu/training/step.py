@@ -29,7 +29,8 @@ from raft_stereo_tpu.training.state import TrainState
 def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
                *, iters: int, loss_gamma: float, max_flow: float,
                jitter: Optional[JitterParams] = None,
-               jitter_seed: int = 0
+               jitter_seed: int = 0,
+               gru_telemetry: bool = False
                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimization step.
 
@@ -63,6 +64,15 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
             batch["image1"], batch["image2"], iters=iters)
         loss, metrics = sequence_loss(preds, flow_gt, valid_gt,
                                       loss_gamma=loss_gamma, max_flow=max_flow)
+        if gru_telemetry and iters > 1:
+            # GRU convergence curve (TrainConfig.gru_telemetry): mean
+            # |disparity update| per refinement iteration, a (iters-1,)
+            # vector riding the metrics dict — fetched with the buffered
+            # drain, never a per-step sync.  stop_gradient: telemetry must
+            # not perturb the backward.
+            p = jax.lax.stop_gradient(preds)
+            metrics = dict(metrics, gru_delta_px=jnp.mean(
+                jnp.abs(p[1:] - p[:-1]), axis=(1, 2, 3)))
         return loss, metrics
 
     (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -86,7 +96,8 @@ def make_train_step(train_cfg: TrainConfig, mesh: Optional[Mesh] = None,
     step = functools.partial(train_step, iters=train_cfg.train_iters,
                              loss_gamma=train_cfg.loss_gamma,
                              max_flow=train_cfg.max_flow,
-                             jitter=jitter, jitter_seed=train_cfg.seed)
+                             jitter=jitter, jitter_seed=train_cfg.seed,
+                             gru_telemetry=train_cfg.gru_telemetry)
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 

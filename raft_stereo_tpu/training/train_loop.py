@@ -155,7 +155,8 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
           validate_fn=None,
           loader: Optional[StereoLoader] = None,
           use_mesh: bool = True,
-          warm_start: bool = False) -> TrainState:
+          warm_start: bool = False,
+          telemetry=None) -> TrainState:
     """Run the training loop; returns the final state.
 
     ``restore`` accepts a previous run's checkpoint directory (exact resume,
@@ -169,6 +170,11 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     AUTHORITATIVE architecture (a checkpoint restore re-derives it, so a
     config captured at CLI time could be stale).
     ``loader`` overrides dataset construction (used by tests).
+    ``telemetry`` is an optional ``telemetry.TrainTelemetry``: step-time
+    split, memory gauges, recompile detection, and structured run events
+    (cli/train.py builds one for --metrics_port).  When None — the default
+    — the loop takes the exact pre-telemetry path: no extra timing calls,
+    no extra device fetches (tests/test_telemetry.py pins this).
     """
     # Defensive: form the process group (no-op single-host / already done)
     # BEFORE the jax.devices() call below latches the backend.
@@ -214,14 +220,14 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             ctx.enter_context(rows_sharding(mesh, axis=ROWS_AXIS))
         return _train_impl(model_cfg, train_cfg, name, data_root,
                            checkpoint_dir, restore, log_dir, validate_fn,
-                           loader, mesh, warm_start)
+                           loader, mesh, warm_start, telemetry)
 
 
 def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                 name: str, data_root: str, checkpoint_dir: str,
                 restore: Optional[str], log_dir: str, validate_fn,
                 loader: Optional[StereoLoader], mesh,
-                warm_start: bool = False) -> TrainState:
+                warm_start: bool = False, telemetry=None) -> TrainState:
     h, w = train_cfg.image_size
     init_shape = (1, h, w, 3)
     rng = jax.random.PRNGKey(train_cfg.seed)
@@ -285,12 +291,16 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
 
     step_fn = make_train_step(train_cfg, mesh=mesh)
     _, schedule = make_optimizer(train_cfg)
-    logger = Logger(log_dir=log_dir, total_steps=start_step)
 
     os.makedirs(checkpoint_dir, exist_ok=True)
     total = train_cfg.num_steps
     step = start_step
     t0 = time.time()
+
+    if telemetry is not None:
+        telemetry.run_start(model_cfg, train_cfg, start_step, name=name)
+        if restore:
+            telemetry.resumed(restore, start_step)
 
     # Preemption safety (beyond the reference, which loses up to 10k steps on
     # a kill — SURVEY.md §5): SIGTERM/SIGINT request a checkpoint at the next
@@ -313,6 +323,8 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             _restore_handlers()
             raise KeyboardInterrupt(f"second signal {signum}: force quit")
         stop_requested = True
+        if telemetry is not None:
+            telemetry.stop_requested(signum)
         log.warning("signal %d: checkpointing at next step boundary "
                     "(send again to force-quit)", signum)
 
@@ -326,87 +338,128 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     # cadence) lets async dispatch run the device ahead and costs one
     # transfer of ~8 scalars x SUM_FREQ instead of SUM_FREQ round-trips.
     pending_metrics = []
+    run_status = "failed"  # overwritten on every clean exit path
 
-    def drain_metrics():
-        if not pending_metrics:
-            return
-        fetched = jax.device_get(pending_metrics)
-        pending_metrics.clear()
-        first = step - len(fetched) + 1
-        # One vectorized schedule eval for the whole span (the per-step
-        # float(schedule(step)) alternative is itself a device sync).
-        lrs = np.asarray(schedule(np.arange(first, step + 1)))
-        for m, lr in zip(fetched, lrs):
-            logger.push(m, lr=float(lr))
+    # Logger is a context manager so the TensorBoard writer closes on EVERY
+    # exit path — return, preemption, or a raising step.
+    with Logger(log_dir=log_dir, total_steps=start_step) as logger:
+        def drain_metrics():
+            if not pending_metrics:
+                return
+            t_drain = time.perf_counter() if telemetry is not None else 0.0
+            fetched = jax.device_get(pending_metrics)
+            pending_metrics.clear()
+            first = step - len(fetched) + 1
+            # One vectorized schedule eval for the whole span (the per-step
+            # float(schedule(step)) alternative is itself a device sync).
+            lrs = np.asarray(schedule(np.arange(first, step + 1)))
+            # The gru_delta_px entry is a VECTOR (per-iteration convergence
+            # curve, TrainConfig.gru_telemetry) — split it off before the
+            # scalar-only logger sees the dicts.
+            gru_deltas = [m.pop("gru_delta_px") for m in fetched
+                          if "gru_delta_px" in m]
+            for m, lr in zip(fetched, lrs):
+                logger.push(m, lr=float(lr))
+            if telemetry is not None:
+                means = ({k: float(np.mean([m[k] for m in fetched]))
+                          for k in fetched[0]} if fetched else {})
+                telemetry.observe_drain(time.perf_counter() - t_drain,
+                                        means, step, window=len(fetched))
+                for d in gru_deltas:
+                    telemetry.observe_gru_deltas(np.asarray(d).ravel())
 
-    # Host->device upload (or global shard assembly) runs on a prefetch
-    # thread, ahead of the step dispatch — the synchronous per-step upload
-    # is otherwise serial with compute (see _DevicePrefetcher).
-    upload = ((lambda b: shard_batch(b, mesh)) if mesh is not None
-              else jax.device_put)
-    if train_cfg.compact_upload:
-        def put(b):
-            # halve the GT bytes on the wire (config.compact_upload):
-            # fp16 flow + uint8 valid, cast back to f32 in train_step
-            c = dict(b)
-            if c["flow"].dtype == np.float32:
-                c["flow"] = c["flow"].astype(np.float16)
-            if c["valid"].dtype == np.float32:
-                c["valid"] = (c["valid"] > 0.5).astype(np.uint8)
-            return upload(c)
-    else:
-        put = upload
-    batches = _DevicePrefetcher(iter(loader), put)
-    try:
-        while True:
-            # Fetch BEFORE the stop collective so loader exhaustion is part
-            # of the global stop decision: any_process's call-count invariant
-            # (once per loop iteration on EVERY process) would break if one
-            # process's sharded loader ran a step short and left this loop
-            # early — the others would hang in the next allgather.  With
-            # exhaustion folded into the collective, all processes break
-            # together at the earliest exhaustion.
-            batch = next(batches, None)
-            # The stop decision must be GLOBAL: a signal lands on one host
-            # only, and every process has to break at the same step boundary
-            # before the collective checkpoint save (any_process is itself a
-            # collective — called once per loop iteration; `step` is
-            # identical on all processes so the short-circuit is consistent).
-            if step >= total or distributed.any_process(
-                    stop_requested or batch is None):
-                break
-            state, metrics = step_fn(state, batch)
-            step += 1
-            pending_metrics.append(metrics)
-            if len(pending_metrics) >= SUM_FREQ:
-                drain_metrics()
-
-            if step % train_cfg.validation_frequency == 0 or step == total:
-                drain_metrics()
-                save_path = os.path.join(checkpoint_dir,
-                                         f"{step}_{name}")
-                _save(save_path, model_cfg, state, step)
-                if run_validation is not None:
-                    variables = {"params": jax.device_get(state.params),
-                                 "batch_stats":
-                                     jax.device_get(state.batch_stats) or {}}
-                    logger.write_dict(run_validation(variables))
-        # Final (or preemption) checkpoint — written while the stop-request
-        # handler may still be installed, so a first signal here cannot kill
-        # a half-written save.
-        _save(os.path.join(checkpoint_dir, name), model_cfg, state, step)
-    finally:
-        # Also on the exception path: a crash at step N must not discard the
-        # buffered metrics of steps N-1..N-SUM_FREQ+1 — that window of the
-        # loss curve is exactly what diagnoses the crash.  Guarded so a
-        # failed fetch can't mask the original exception.
+        # Host->device upload (or global shard assembly) runs on a prefetch
+        # thread, ahead of the step dispatch — the synchronous per-step
+        # upload is otherwise serial with compute (see _DevicePrefetcher).
+        upload = ((lambda b: shard_batch(b, mesh)) if mesh is not None
+                  else jax.device_put)
+        if train_cfg.compact_upload:
+            def put(b):
+                # halve the GT bytes on the wire (config.compact_upload):
+                # fp16 flow + uint8 valid, cast back to f32 in train_step
+                c = dict(b)
+                if c["flow"].dtype == np.float32:
+                    c["flow"] = c["flow"].astype(np.float16)
+                if c["valid"].dtype == np.float32:
+                    c["valid"] = (c["valid"] > 0.5).astype(np.uint8)
+                return upload(c)
+        else:
+            put = upload
+        batches = _DevicePrefetcher(iter(loader), put)
         try:
-            drain_metrics()
-        except Exception:
-            log.exception("could not drain buffered metrics")
-        batches.close()
-        logger.close()
-        _restore_handlers()
+            while True:
+                # Telemetry timing is gated on ``telemetry is not None`` at
+                # every site: the disabled path is the exact pre-telemetry
+                # loop — no clock reads, no extra device fetches.
+                if telemetry is not None:
+                    t_loop = time.perf_counter()
+                # Fetch BEFORE the stop collective so loader exhaustion is
+                # part of the global stop decision: any_process's call-count
+                # invariant (once per loop iteration on EVERY process) would
+                # break if one process's sharded loader ran a step short and
+                # left this loop early — the others would hang in the next
+                # allgather.  With exhaustion folded into the collective,
+                # all processes break together at the earliest exhaustion.
+                batch = next(batches, None)
+                if telemetry is not None:
+                    t_batch = time.perf_counter()
+                # The stop decision must be GLOBAL: a signal lands on one
+                # host only, and every process has to break at the same step
+                # boundary before the collective checkpoint save
+                # (any_process is itself a collective — called once per loop
+                # iteration; `step` is identical on all processes so the
+                # short-circuit is consistent).
+                if step >= total or distributed.any_process(
+                        stop_requested or batch is None):
+                    break
+                if telemetry is not None:
+                    telemetry.note_batch(batch)
+                state, metrics = step_fn(state, batch)
+                step += 1
+                if telemetry is not None:
+                    # dispatch leg only (async dispatch returns at submit);
+                    # the device-bound tail shows up in the drain histogram
+                    telemetry.observe_step(
+                        step, data_wait_s=t_batch - t_loop,
+                        dispatch_s=time.perf_counter() - t_batch)
+                pending_metrics.append(metrics)
+                if len(pending_metrics) >= SUM_FREQ:
+                    drain_metrics()
+
+                if (step % train_cfg.validation_frequency == 0
+                        or step == total):
+                    drain_metrics()
+                    save_path = os.path.join(checkpoint_dir,
+                                             f"{step}_{name}")
+                    _save(save_path, model_cfg, state, step, telemetry)
+                    if run_validation is not None:
+                        variables = {
+                            "params": jax.device_get(state.params),
+                            "batch_stats":
+                                jax.device_get(state.batch_stats) or {}}
+                        results = run_validation(variables)
+                        logger.write_dict(results)
+                        if telemetry is not None:
+                            telemetry.observe_validation(results, step)
+            # Final (or preemption) checkpoint — written while the
+            # stop-request handler may still be installed, so a first signal
+            # here cannot kill a half-written save.
+            _save(os.path.join(checkpoint_dir, name), model_cfg, state,
+                  step, telemetry)
+            run_status = "stopped" if stop_requested else "complete"
+        finally:
+            # Also on the exception path: a crash at step N must not discard
+            # the buffered metrics of steps N-1..N-SUM_FREQ+1 — that window
+            # of the loss curve is exactly what diagnoses the crash.
+            # Guarded so a failed fetch can't mask the original exception.
+            try:
+                drain_metrics()
+            except Exception:
+                log.exception("could not drain buffered metrics")
+            batches.close()
+            _restore_handlers()
+            if telemetry is not None:
+                telemetry.run_end(run_status, step)
 
     if stop_requested:
         log.warning("stopped by signal at step %d; resume with "
@@ -430,6 +483,9 @@ def _arrays_of(state: TrainState):
 
 
 def _save(path: str, model_cfg: RaftStereoConfig, state: TrainState,
-          step: int) -> None:
+          step: int, telemetry=None) -> None:
+    t0 = time.perf_counter() if telemetry is not None else 0.0
     ckpt.save_checkpoint(path, model_cfg, _arrays_of(state))
     log.info("saved checkpoint %s", path)
+    if telemetry is not None:
+        telemetry.observe_checkpoint(time.perf_counter() - t0, path, step)
