@@ -37,15 +37,43 @@ def build_service(args):
         data_parallel=args.data_parallel, iters=args.valid_iters,
         shape_bucket=args.shape_bucket,
         fetch_dtype=args.fetch_dtype,
-        default_deadline_ms=args.deadline_ms)
+        default_deadline_ms=args.deadline_ms,
+        trace_sample_rate=args.trace_sample_rate)
     return StereoService(cfg, variables, serve_cfg)
+
+
+def build_observability(args, service):
+    """Opt-in second observability layer: run-event log, flight recorder,
+    and the serving anomaly watchdog, wired into the service's tracer +
+    instrument registry.  Returns ``(events, recorder, watchdog)``, any of
+    which may be None."""
+    from raft_stereo_tpu.telemetry import (AnomalySink, EventLog,
+                                           FlightRecorder, ServingWatchdog)
+
+    events = EventLog(args.event_log) if args.event_log else None
+    recorder = None
+    if args.event_log or args.watchdog or args.trace_sample_rate > 0:
+        recorder = FlightRecorder(args.flight_recorder_dir,
+                                  tracer=service.tracer,
+                                  registry=service.metrics.registry)
+        if events is not None:
+            events.add_sink(recorder.record_event)
+    watchdog = None
+    if args.watchdog:
+        sink = AnomalySink(events=events, recorder=recorder,
+                           counter=service.metrics.anomalies)
+        watchdog = ServingWatchdog(sink, service.metrics,
+                                   max_queue=args.max_queue).start()
+    return events, recorder, watchdog
 
 
 def run_serve(args) -> int:
     from raft_stereo_tpu.serving.http import StereoHTTPServer
 
     service = build_service(args)
-    server = StereoHTTPServer(service, host=args.host, port=args.port)
+    events, recorder, watchdog = build_observability(args, service)
+    server = StereoHTTPServer(service, host=args.host, port=args.port,
+                              recorder=recorder)
     stop = threading.Event()
     forced = threading.Event()
 
@@ -72,6 +100,8 @@ def run_serve(args) -> int:
     try:
         server.serve_forever()
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         if forced.is_set():
             log.warning("force quit: dropping %d queued requests",
                         service.batcher.depth)
@@ -82,6 +112,8 @@ def run_serve(args) -> int:
                      "complete" if drained else
                      f"timed out after {args.drain_timeout_s:.0f}s",
                      service.metrics.render_text())
+        if events is not None:
+            events.close()
     return 0
 
 
@@ -120,6 +152,23 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["fp16", "bf16"],
                    help="half-precision device->host disparity fetch "
                         "(halves the down-leg bytes; results stay f32)")
+    # Observability layer 2 (telemetry/): all off by default.
+    p.add_argument("--trace_sample_rate", type=float, default=0.0,
+                   help="fraction of requests whose span tree (admission/"
+                        "queue/dispatch/fetch/respond) is recorded and "
+                        "served as Chrome trace JSON on GET /debug/spans; "
+                        "0 (default) disables tracing")
+    p.add_argument("--event_log", default=None,
+                   help="append structured JSONL run events (anomalies) "
+                        "to this file")
+    p.add_argument("--watchdog", action="store_true",
+                   help="run the serving anomaly watchdog: queue "
+                        "saturation and deadline-miss-rate detectors that "
+                        "write a flight-recorder bundle on trigger")
+    p.add_argument("--flight_recorder_dir", default="flightrecorder",
+                   help="debug-bundle directory for the flight recorder "
+                        "(span ring, /metrics snapshot, stack dump, "
+                        "device memory)")
     common.add_arch_overrides(p)
     return p
 

@@ -51,6 +51,7 @@ def configs_from_args(args):
         seed=args.seed,
         data_parallel=args.data_parallel,
         gru_telemetry=args.gru_telemetry,
+        trace_sample_rate=args.trace_sample_rate,
     )
     return model_cfg, train_cfg
 
@@ -129,6 +130,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also record per-iteration GRU disparity-delta "
                         "magnitudes (convergence curve; small on-device "
                         "reduction per iteration)")
+    p.add_argument("--trace_sample_rate", type=float, default=0.0,
+                   help="fraction of train steps whose span tree "
+                        "(data-wait/dispatch/drain/checkpoint) is recorded "
+                        "and served as Chrome trace JSON on GET "
+                        "/debug/spans; 0 (default) disables tracing")
+    p.add_argument("--stall_watchdog", action="store_true",
+                   help="alarm (anomaly event + flight-recorder bundle) "
+                        "when no step completes within 10x the rolling "
+                        "median step time")
+    p.add_argument("--flight_recorder_dir", default=None,
+                   help="debug-bundle directory for the flight recorder "
+                        "(spans + events ring, /metrics snapshot, stack "
+                        "dump, device memory); defaults to "
+                        "<log_dir>/flightrecorder")
     common.add_arch_overrides(p)
     return p
 
@@ -163,20 +178,34 @@ def main(argv=None):
     if args.metrics_port is not None and event_log_path is None:
         event_log_path = os.path.join(args.log_dir, "events.jsonl")
     if args.metrics_port is not None or event_log_path is not None:
-        from raft_stereo_tpu.telemetry import (EventLog, TelemetryHTTPServer,
+        from raft_stereo_tpu.telemetry import (EventLog, FlightRecorder,
+                                               SpanTracer,
+                                               TelemetryHTTPServer,
                                                TrainTelemetry)
         if event_log_path is not None:
             events = EventLog(event_log_path)
-        telemetry = TrainTelemetry(events=events)
+        tracer = SpanTracer(train_cfg.trace_sample_rate)
+        recorder = FlightRecorder(
+            args.flight_recorder_dir
+            or os.path.join(args.log_dir, "flightrecorder"),
+            tracer=tracer)
+        telemetry = TrainTelemetry(events=events, tracer=tracer,
+                                   recorder=recorder)
+        recorder.registry = telemetry.registry
+        if args.stall_watchdog:
+            telemetry.enable_stall_watchdog()
         if args.metrics_port is not None:
             from raft_stereo_tpu.telemetry import TraceCapture
             server = TelemetryHTTPServer(
                 telemetry.registry, telemetry.healthz,
                 host=args.metrics_host, port=args.metrics_port,
                 trace=TraceCapture(
-                    root=os.path.join(args.log_dir, "profiles"))).start()
+                    root=os.path.join(args.log_dir, "profiles")),
+                tracer=tracer, recorder=recorder).start()
             log.info("training metrics endpoint on %s (GET /metrics, "
-                     "GET /healthz, POST /debug/trace)", server.url)
+                     "GET /healthz, GET /debug/spans, GET /debug/stacks, "
+                     "GET /debug/flightrecorder, POST /debug/trace)",
+                     server.url)
 
     from raft_stereo_tpu.training.train_loop import train
     try:

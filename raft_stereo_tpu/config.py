@@ -316,6 +316,13 @@ class TrainConfig:
     # existing buffered metric fetch (no extra device sync); off by default
     # because it adds a small on-device reduction per iteration.
     gru_telemetry: bool = False
+    # Fraction of train steps whose span tree is recorded
+    # (telemetry/spans.py: step root with data-wait / dispatch / drain /
+    # checkpoint children, exported as Chrome trace JSON via GET
+    # /debug/spans).  0.0 (default) disables tracing; the spans are
+    # reconstructed from timings the loop already clocks, so even 1.0 adds
+    # no extra clock reads or device fetches to the hot loop.
+    trace_sample_rate: float = 0.0
     # Runtime
     validation_frequency: int = 10_000
     seed: int = 1234

@@ -44,13 +44,18 @@ class DeadlineExceeded(RuntimeError):
 @dataclasses.dataclass
 class Request:
     """One queued stereo pair.  ``payload`` is opaque to the batcher (the
-    service stores images + padder there); ``bucket`` keys compatibility."""
+    service stores images + padder there); ``bucket`` keys compatibility.
+    ``trace``/``queue_span`` are likewise opaque (telemetry/spans.py
+    handles of a sampled request — the service opens/closes them; the
+    batcher only carries them across its threads)."""
 
     bucket: Tuple[int, int]
     payload: object
     future: Future
     t_enqueue: float
     deadline: Optional[float] = None  # absolute monotonic seconds
+    trace: Optional[object] = None
+    queue_span: Optional[object] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline

@@ -18,11 +18,18 @@ Endpoints:
   Errors map to transport codes: 429 (queue full, with ``Retry-After``),
   503 (draining), 504 (deadline passed in queue), 400 (malformed input).
 * ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
-* ``GET /healthz`` — one JSON line: status, queue depth, device count.
+* ``GET /healthz`` — one JSON line: status, queue depth, inflight count,
+  last-batch age, device count (the load balancer's liveness probe AND a
+  human's first diagnostic stop).
 * ``POST /debug/trace`` — bounded on-demand profiler window on the live
   serving process (telemetry/trace.py); optional JSON body
   ``{"duration_ms": N}``; replies with the trace directory, 409 while a
   window is already open.
+* ``GET /debug/spans`` / ``GET /debug/stacks`` / ``GET|POST
+  /debug/flightrecorder`` — the same debug surface the training endpoint
+  serves (telemetry/http.py ``handle_debug_get``/``handle_debug_post``):
+  the request-path span ring as Chrome trace JSON, an all-thread stack
+  dump, and flight-recorder status / forced bundle dump.
 
 ``ThreadingHTTPServer`` gives one Python thread per connection; the real
 concurrency limit is the service's bounded queue, which is the point —
@@ -42,7 +49,10 @@ import numpy as np
 
 from raft_stereo_tpu.serving.batcher import DeadlineExceeded, Overloaded
 from raft_stereo_tpu.serving.service import StereoService
-from raft_stereo_tpu.telemetry.http import handle_trace_post
+from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
+from raft_stereo_tpu.telemetry.http import (handle_debug_get,
+                                            handle_debug_post,
+                                            handle_trace_post)
 from raft_stereo_tpu.telemetry.trace import TraceCapture
 
 log = logging.getLogger(__name__)
@@ -85,7 +95,8 @@ def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
 
 
 def make_handler(service: StereoService,
-                 trace: Optional[TraceCapture] = None):
+                 trace: Optional[TraceCapture] = None,
+                 recorder: Optional[FlightRecorder] = None):
     """Handler class closed over ``service`` (BaseHTTPRequestHandler is
     instantiated per request by the server, so state rides the closure)."""
     trace = trace if trace is not None else TraceCapture()
@@ -111,7 +122,8 @@ def make_handler(service: StereoService,
                         "application/json", extra_headers)
 
         def do_GET(self):
-            path = urlparse(self.path).path
+            url = urlparse(self.path)
+            path = url.path
             if path == "/metrics":
                 self._reply(200, service.metrics.render_text().encode(),
                             "text/plain; version=0.0.4")
@@ -121,7 +133,14 @@ def make_handler(service: StereoService,
                                else "ok"),
                     "queue_depth": service.batcher.depth,
                     "inflight": service.metrics.inflight.value,
+                    "last_batch_age_s":
+                        service.metrics.last_batch_age_s(),
+                    "anomalies": service.metrics.anomalies.value,
                     "devices": len(service.devices)})
+            elif handle_debug_get(path, url.query, service.tracer, recorder,
+                                  service.metrics.registry,
+                                  self._reply, self._reply_json):
+                pass
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
 
@@ -129,6 +148,8 @@ def make_handler(service: StereoService,
             url = urlparse(self.path)
             if url.path == "/debug/trace":
                 handle_trace_post(self, trace, self._reply_json)
+                return
+            if handle_debug_post(url.path, recorder, self._reply_json):
                 return
             if url.path != "/v1/disparity":
                 self._reply_json(404, {"error": f"no route {url.path!r}"})
@@ -182,11 +203,14 @@ class StereoHTTPServer:
     on a daemon thread (in-process tests)."""
 
     def __init__(self, service: StereoService, host: str = "127.0.0.1",
-                 port: int = 8551):
+                 port: int = 8551,
+                 recorder: Optional[FlightRecorder] = None):
         self.service = service
         self.trace = TraceCapture()
-        self.server = ThreadingHTTPServer((host, port),
-                                          make_handler(service, self.trace))
+        self.recorder = recorder
+        self.server = ThreadingHTTPServer(
+            (host, port), make_handler(service, self.trace,
+                                       recorder=recorder))
         self._thread = None
 
     @property

@@ -11,6 +11,8 @@ standard instrument set — still lives here.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Optional
 
 from raft_stereo_tpu.telemetry.registry import (  # noqa: F401 — re-exports
@@ -70,6 +72,31 @@ class ServingMetrics:
             "serve_fetch_seconds", "device->host transfer of the results")
         self.total_latency = r.histogram(
             "serve_total_latency_seconds", "admission -> response ready")
+        self.anomalies = r.counter(
+            "serve_anomalies_total",
+            "anomalies detected (queue saturation, deadline-miss rate)")
+        self.last_batch_unix = r.gauge(
+            "serve_last_batch_unix_seconds",
+            "wall-clock time the last micro-batch finished (0 until one "
+            "does)")
+        self._age_lock = threading.Lock()
+        self._last_batch_mono: Optional[float] = None
+
+    def note_batch_done(self) -> None:
+        """Stamp micro-batch completion — the freshness signal behind
+        ``/healthz``'s ``last_batch_age_s`` (a serving twin of the train
+        loop's ``last_step_age_s``)."""
+        self.last_batch_unix.set(time.time())
+        with self._age_lock:
+            self._last_batch_mono = time.monotonic()
+
+    def last_batch_age_s(self) -> Optional[float]:
+        """Seconds since the last micro-batch finished; None before the
+        first one (an idle-from-boot service is not stale, it is idle)."""
+        with self._age_lock:
+            last = self._last_batch_mono
+        return (round(time.monotonic() - last, 3)
+                if last is not None else None)
 
     def render_text(self) -> str:
         return self.registry.render_text()

@@ -69,6 +69,11 @@ class ServeConfig:
     max_cached_shapes: int = 16  # per-worker compile cache bound
     fetch_dtype: Optional[str] = None    # "fp16" | "bf16" half fetch
     default_deadline_ms: Optional[float] = None  # per-request override wins
+    # Fraction of requests whose span tree is recorded (telemetry/spans.py:
+    # admission -> queue -> dispatch -> fetch -> respond, exported as
+    # Chrome trace JSON via GET /debug/spans).  0.0 (default) disables
+    # tracing entirely — every span site takes the constant-time None exit.
+    trace_sample_rate: float = 0.0
 
     def __post_init__(self):
         if self.batch_mode not in BATCH_MODES:
@@ -77,6 +82,9 @@ class ServeConfig:
         if self.data_parallel < 1:
             raise ValueError(f"data_parallel={self.data_parallel} must be "
                              f">= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(f"trace_sample_rate={self.trace_sample_rate} "
+                             f"must be in [0, 1]")
 
 
 @dataclasses.dataclass
@@ -121,10 +129,19 @@ class StereoService:
     def __init__(self, config: RaftStereoConfig, variables,
                  serve_cfg: ServeConfig = ServeConfig(),
                  devices: Optional[Sequence] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None):
         import jax
 
+        from raft_stereo_tpu.telemetry.spans import SpanTracer
+
         self.serve_cfg = serve_cfg
+        # Request-path span tracer (telemetry/spans.py).  At the default
+        # sample rate 0.0 every start_trace returns None and the span
+        # plumbing below is a handful of no-op attribute checks per
+        # request — serving numerics and dispatch behavior are untouched.
+        self.tracer = (tracer if tracer is not None
+                       else SpanTracer(serve_cfg.trace_sample_rate))
         if devices is None:
             local = jax.local_devices()
             if serve_cfg.data_parallel > len(local):
@@ -177,6 +194,7 @@ class StereoService:
         service is draining; the Future fails with ``DeadlineExceeded`` if
         the request's deadline passes before a device picks it up.
         """
+        t_admit = time.perf_counter()
         left, right = np.asarray(left), np.asarray(right)
         if left.ndim != 3 or left.shape != right.shape:
             raise ValueError(
@@ -195,8 +213,43 @@ class StereoService:
                       future=Future(), t_enqueue=now,
                       deadline=(None if deadline_ms is None
                                 else now + deadline_ms / 1e3))
-        self.batcher.submit(req)   # raises Overloaded at the door
+        # Sampled request: root span + admission (validate/pad) span; the
+        # queue span opens here and closes at worker pickup (_run_batch) or
+        # in the done-callback for requests dropped in the queue.
+        trace = self.tracer.start_trace(
+            "serve.request", bucket=str(req.bucket),
+            deadline_ms=deadline_ms)
+        if trace is not None:
+            req.trace = trace
+            self.tracer.add_span("serve.admission", trace,
+                                 t_admit, time.perf_counter(),
+                                 bucket=str(req.bucket))
+            req.queue_span = self.tracer.start_span("serve.queue", trace)
+            req.future.add_done_callback(
+                lambda f, r=req: self._finish_request_trace(r, f))
+        try:
+            self.batcher.submit(req)   # raises Overloaded at the door
+        except Overloaded:
+            if trace is not None and trace.root is not None:
+                trace.root.set_attr("status", "overloaded")
+                self._finish_request_trace(req, None)
+            raise
         return req.future
+
+    def _finish_request_trace(self, req: Request, future) -> None:
+        """Close the queue span (if the worker never picked the request
+        up) and the root span; idempotence guards the two close paths
+        (worker pickup vs future resolution)."""
+        qs = req.queue_span
+        if qs is not None and qs.t_end is None:
+            self.tracer.finish(qs)
+        root = req.trace.root if req.trace is not None else None
+        if root is not None and root.t_end is None:
+            if future is not None:
+                exc = future.exception()
+                root.set_attr("status",
+                              "ok" if exc is None else type(exc).__name__)
+            self.tracer.finish(root)
 
     def infer(self, left: np.ndarray, right: np.ndarray,
               deadline_ms: Optional[float] = None,
@@ -239,6 +292,16 @@ class StereoService:
         bucket = batch[0].bucket
         n = len(batch)
 
+        # Sampled requests: the queue leg ends at worker pickup; the
+        # dispatch/fetch spans below share the batch's time window but land
+        # in each request's own trace (a trace stays self-contained).
+        sampled = [r for r in batch if r.trace is not None]
+        p_pickup = time.perf_counter() if sampled else 0.0
+        for r in sampled:
+            if r.queue_span is not None and r.queue_span.t_end is None:
+                r.queue_span.set_attr("batch_size", n)
+                self.tracer.finish(r.queue_span)
+
         with profiling.annotate("serve.device"):
             if self.serve_cfg.batch_mode == "chain":
                 # N batch-1 dispatches through the one per-shape executable
@@ -271,10 +334,19 @@ class StereoService:
             for o in outs:
                 jax.block_until_ready(o)
         t_ready = time.monotonic()
+        p_ready = time.perf_counter() if sampled else 0.0
 
         with profiling.annotate("serve.fetch"):
             flows_padded = [np.asarray(o) for o in outs]
         t_fetched = time.monotonic()
+        p_fetched = time.perf_counter() if sampled else 0.0
+        for r in sampled:
+            self.tracer.add_span(
+                "serve.dispatch", r.trace, p_pickup, p_ready,
+                bucket=str(bucket), batch_size=n, device=str(device),
+                mode=self.serve_cfg.batch_mode)
+            self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
+                                 batch_size=n)
 
         device_s = t_ready - t_pickup
         fetch_s = t_fetched - t_ready
@@ -282,19 +354,25 @@ class StereoService:
         self.metrics.batch_occupancy.observe(n)
         self.metrics.device_time.observe(device_s)
         self.metrics.fetch_time.observe(fetch_s)
+        self.metrics.note_batch_done()
         for r, fp, wait in zip(batch, flows_padded, waits):
+            exemplar = r.trace.trace_id if r.trace is not None else None
+            p_respond = time.perf_counter() if exemplar is not None else 0.0
             fp = fp if fp.ndim == 3 else fp[None]        # stack mode: (Hp,Wp)
             flow = r.payload.padder.unpad(fp)[0]
             if flow.dtype != np.float32:                 # half-precision fetch
                 flow = flow.astype(np.float32)
             total = t_fetched - r.t_enqueue
-            self.metrics.queue_wait.observe(wait)
-            self.metrics.total_latency.observe(total)
+            self.metrics.queue_wait.observe(wait, exemplar=exemplar)
+            self.metrics.total_latency.observe(total, exemplar=exemplar)
             self.metrics.completed.inc()
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
                 batch_size=n))
+            if exemplar is not None:
+                self.tracer.add_span("serve.respond", r.trace, p_respond,
+                                     time.perf_counter())
 
     # -------------------------------------------------------------- shutdown
     def drain(self, timeout: Optional[float] = None) -> bool:

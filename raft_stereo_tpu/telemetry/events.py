@@ -24,11 +24,14 @@ most the event being written, and every earlier line stays valid JSON.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
+
+log = logging.getLogger(__name__)
 
 SCHEMA_VERSION = 1
 
@@ -97,6 +100,15 @@ class EventLog:
         self._lock = threading.Lock()
         self._f = open(path, "a")
         self._seq = 0
+        self._sinks: list = []
+
+    def add_sink(self, sink: Callable[[Dict[str, object]], None]) -> None:
+        """Mirror every emitted record into ``sink(rec)`` as well as the
+        file — how the flight recorder keeps its bounded in-memory ring of
+        recent events (telemetry/flight_recorder.py) without a second
+        emission path that could drift from the log."""
+        with self._lock:
+            self._sinks.append(sink)
 
     def emit(self, event: str, **fields) -> Dict[str, object]:
         """Write one event line; returns the full record written."""
@@ -108,6 +120,11 @@ class EventLog:
             self._seq += 1
             self._f.write(json.dumps(rec, default=_jsonable) + "\n")
             self._f.flush()
+            for sink in self._sinks:
+                try:
+                    sink(rec)
+                except Exception:  # pragma: no cover - sink must not kill
+                    log.exception("event sink failed")      # the emitter
             return rec
 
     def close(self) -> None:
@@ -137,15 +154,32 @@ def _jsonable(v):
 
 
 def replay(path: str) -> Iterator[Dict[str, object]]:
-    """Read an event log back in order.  A torn final line (the process was
-    killed mid-write) is skipped, matching the at-most-one-line loss
-    guarantee of ``EventLog.emit``."""
+    """Read an event log back in order, yielding complete records.
+
+    A torn FINAL line (the process was killed mid-write — the at-most-one-
+    line loss ``EventLog.emit`` guarantees) is tolerated with a warning
+    instead of raising.  A malformed line anywhere EARLIER is not part of
+    that guarantee — it means real corruption — so it is also skipped with
+    a (louder) warning rather than silently, and the complete records
+    around it still come back; a replay must never lose the readable
+    majority of a run's timeline to one bad line."""
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except ValueError:
-                continue
+        lines = f.readlines()
+    last = len(lines) - 1
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        try:
+            yield json.loads(stripped)
+        except ValueError:
+            if i == last and not line.endswith("\n"):
+                log.warning(
+                    "event log %s: torn final line (%d bytes) skipped — "
+                    "the process was likely killed mid-write", path,
+                    len(line))
+            else:
+                log.warning(
+                    "event log %s: malformed record at line %d skipped — "
+                    "this is mid-file corruption, not a torn tail", path,
+                    i + 1)

@@ -1,6 +1,6 @@
 """Training metrics endpoint: the serving HTTP surface, minus the model.
 
-``cli/train.py --metrics_port`` serves three routes off the training
+``cli/train.py --metrics_port`` serves these routes off the training
 process (same stdlib ``ThreadingHTTPServer`` machinery as
 serving/http.py, same response conventions):
 
@@ -12,6 +12,18 @@ serving/http.py, same response conventions):
 * ``POST /debug/trace`` — open a bounded on-demand profiler window
   (telemetry/trace.py) on the live process; body is optional JSON
   ``{"duration_ms": N}``.  409 while a window is already open.
+* ``GET /debug/spans`` — the span-tracer ring (telemetry/spans.py) as
+  Chrome trace-event JSON: save the body, open it in Perfetto.  Latency-
+  histogram exemplars (sampled trace IDs) ride along under ``?exemplars=1``
+  as a JSON wrapper instead of the bare trace.
+* ``GET /debug/stacks`` — a plain-text stack dump of every live thread
+  (where is the loop stuck RIGHT NOW).
+* ``GET /debug/flightrecorder`` — recorder status: ring occupancy, dump
+  count, bundle paths.  ``POST`` to the same path forces a bundle dump.
+
+The /debug surface is shared verbatim with the serving endpoint
+(serving/http.py routes through ``handle_debug_get``/``handle_debug_post``
+too), so one operator playbook covers both processes.
 
 Scrapes run on server threads while the train loop owns the main thread —
 every instrument read is lock-guarded host state, so a scrape never
@@ -25,7 +37,10 @@ import logging
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
+from raft_stereo_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                       dump_all_stacks)
 from raft_stereo_tpu.telemetry.registry import MetricsRegistry
+from raft_stereo_tpu.telemetry.spans import SpanTracer, to_chrome_trace
 from raft_stereo_tpu.telemetry.trace import TraceBusy, TraceCapture
 
 log = logging.getLogger(__name__)
@@ -68,9 +83,66 @@ def handle_trace_post(handler: BaseHTTPRequestHandler,
     reply_json(200, info)
 
 
+def handle_debug_get(path: str, query: str,
+                     tracer: Optional[SpanTracer],
+                     recorder: Optional[FlightRecorder],
+                     registry: Optional[MetricsRegistry],
+                     reply: Callable[[int, bytes, str], None],
+                     reply_json: Callable[[int, object], None]) -> bool:
+    """The shared GET /debug/* surface (training AND serving endpoints).
+    Returns True when the path was one of ours."""
+    if path == "/debug/spans":
+        if tracer is None:
+            reply_json(404, {"error": "span tracing not wired on this "
+                                      "endpoint"})
+            return True
+        chrome = to_chrome_trace(tracer.spans())
+        if "exemplars=1" in query:
+            exemplars = {}
+            if registry is not None:
+                for name, inst in sorted(registry.items()):
+                    ex = getattr(inst, "exemplars", None)
+                    if ex is not None and ex():
+                        exemplars[name] = ex()
+            reply_json(200, {"stats": tracer.stats(),
+                             "exemplars": exemplars, "trace": chrome})
+        else:
+            reply(200, json.dumps(chrome).encode(), "application/json")
+        return True
+    if path == "/debug/stacks":
+        reply(200, dump_all_stacks().encode(), "text/plain; charset=utf-8")
+        return True
+    if path == "/debug/flightrecorder":
+        if recorder is None:
+            reply_json(404, {"error": "flight recorder not wired on this "
+                                      "endpoint"})
+            return True
+        reply_json(200, recorder.status())
+        return True
+    return False
+
+
+def handle_debug_post(path: str, recorder: Optional[FlightRecorder],
+                      reply_json: Callable[[int, object], None]) -> bool:
+    """POST /debug/flightrecorder — force a bundle dump on the live
+    process (the operator's "capture NOW" button).  Returns True when the
+    path was ours."""
+    if path != "/debug/flightrecorder":
+        return False
+    if recorder is None:
+        reply_json(404, {"error": "flight recorder not wired on this "
+                                  "endpoint"})
+        return True
+    bundle = recorder.dump("manual", force=True)
+    reply_json(200, {"bundle": bundle})
+    return True
+
+
 def make_telemetry_handler(registry: MetricsRegistry,
                            healthz_fn: Callable[[], Dict[str, object]],
-                           trace: Optional[TraceCapture] = None):
+                           trace: Optional[TraceCapture] = None,
+                           tracer: Optional[SpanTracer] = None,
+                           recorder: Optional[FlightRecorder] = None):
     """Handler class closed over the instruments (the serving/http.py
     pattern: BaseHTTPRequestHandler is instantiated per request, so state
     rides the closure)."""
@@ -93,12 +165,15 @@ def make_telemetry_handler(registry: MetricsRegistry,
                         "application/json")
 
         def do_GET(self):
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path == "/metrics":
                 self._reply(200, registry.render_text().encode(),
                             "text/plain; version=0.0.4")
             elif path == "/healthz":
                 self._reply_json(200, healthz_fn())
+            elif handle_debug_get(path, query, tracer, recorder, registry,
+                                  self._reply, self._reply_json):
+                pass
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
 
@@ -106,6 +181,8 @@ def make_telemetry_handler(registry: MetricsRegistry,
             path = self.path.split("?", 1)[0]
             if path == "/debug/trace":
                 handle_trace_post(self, trace, self._reply_json)
+            elif handle_debug_post(path, recorder, self._reply_json):
+                pass
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
 
@@ -120,12 +197,17 @@ class TelemetryHTTPServer:
     def __init__(self, registry: MetricsRegistry,
                  healthz_fn: Callable[[], Dict[str, object]],
                  host: str = "127.0.0.1", port: int = 9100,
-                 trace: Optional[TraceCapture] = None):
+                 trace: Optional[TraceCapture] = None,
+                 tracer: Optional[SpanTracer] = None,
+                 recorder: Optional[FlightRecorder] = None):
         self.registry = registry
         self.trace = trace if trace is not None else TraceCapture()
+        self.tracer = tracer
+        self.recorder = recorder
         self.server = ThreadingHTTPServer(
             (host, port),
-            make_telemetry_handler(registry, healthz_fn, self.trace))
+            make_telemetry_handler(registry, healthz_fn, self.trace,
+                                   tracer=tracer, recorder=recorder))
         self._thread = None
 
     @property

@@ -20,8 +20,10 @@ percentiles describe the most recent ``reservoir`` samples.
 
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Dict, Iterable, List, Tuple
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -34,11 +36,52 @@ DEFAULT_LATENCY_BUCKETS = (
     0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
 
 
+def escape_help(s: str) -> str:
+    r"""HELP-line escaping per the Prometheus text exposition format:
+    backslash and line feed (``\\`` and ``\n``)."""
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def escape_label_value(s: str) -> str:
+    r"""Label-value escaping per the exposition format: backslash,
+    double-quote, and line feed (``\\``, ``\"``, ``\n``).  Order matters —
+    backslashes first, or the escapes themselves get re-escaped."""
+    return (s.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def unescape_label_value(s: str) -> str:
+    """Inverse of ``escape_label_value`` (the round-trip test's parser
+    half; also handy for consumers of the text format)."""
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def render_labels(labels: Optional[Dict[str, str]]) -> str:
+    """``{k="v",...}`` with escaped values; empty string for no labels."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(str(v))}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonic counter (thread-safe)."""
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else {}
         self._lock = threading.Lock()
         self._value = 0
 
@@ -52,16 +95,18 @@ class Counter:
             return self._value
 
     def render(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
+        return [f"# HELP {self.name} {escape_help(self.help)}",
                 f"# TYPE {self.name} counter",
-                f"{self.name} {self.value}"]
+                f"{self.name}{render_labels(self.labels)} {self.value}"]
 
 
 class Gauge:
     """Instant value (thread-safe); ``set``/``inc``/``dec``."""
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else {}
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -82,9 +127,14 @@ class Gauge:
             return self._value
 
     def render(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
+        return [f"# HELP {self.name} {escape_help(self.help)}",
                 f"# TYPE {self.name} gauge",
-                f"{self.name} {self.value:g}"]
+                f"{self.name}{render_labels(self.labels)} {self.value:g}"]
+
+
+# Exemplars kept per histogram: enough to link the last few latency
+# outliers to their trace IDs without growing the scrape payload.
+EXEMPLAR_RING = 16
 
 
 class Histogram:
@@ -92,12 +142,22 @@ class Histogram:
 
     ``observe`` is O(1); ``percentile`` sorts the reservoir on demand
     (scrape/report-time cost, not request-time).
+
+    ``observe(v, exemplar=trace_id)`` additionally attaches a sampled
+    trace ID as an exemplar (a bounded ring of recent ones): the bridge
+    from an aggregate latency histogram to the specific request traces
+    behind it (``GET /debug/spans`` serves the span side).  Exemplars ride
+    the JSON debug surface, not the text exposition — the 0.0.4 text
+    format predates exemplar syntax and adding OpenMetrics markers would
+    break strict scrapers.
     """
 
     def __init__(self, name: str, help: str = "",
                  buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
-                 reservoir: int = 4096):
+                 reservoir: int = 4096,
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else {}
         self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
         self._lock = threading.Lock()
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
@@ -105,8 +165,10 @@ class Histogram:
         self._count = 0
         self._samples = np.zeros(max(1, reservoir), np.float64)
         self._next = 0  # ring-buffer write cursor
+        self._exemplars: "collections.deque[Dict[str, object]]" = (
+            collections.deque(maxlen=EXEMPLAR_RING))
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             i = 0
@@ -120,6 +182,14 @@ class Histogram:
             self._count += 1
             self._samples[self._next % len(self._samples)] = v
             self._next += 1
+            if exemplar is not None:
+                self._exemplars.append(
+                    {"value": v, "trace_id": exemplar, "ts": time.time()})
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """Recent (value, trace_id, ts) exemplars, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._exemplars]
 
     @property
     def count(self) -> int:
@@ -149,15 +219,17 @@ class Histogram:
     def render(self) -> List[str]:
         with self._lock:
             counts, total, s = list(self._counts), self._count, self._sum
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {escape_help(self.help)}",
                  f"# TYPE {self.name} histogram"]
+        base = render_labels(self.labels)
+        suffix = base[:-1] + "," if base else "{"  # merge le into labels
         cum = 0
         for edge, c in zip(self.buckets, counts):
             cum += c
-            lines.append(f'{self.name}_bucket{{le="{edge:g}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {s:g}")
-        lines.append(f"{self.name}_count {total}")
+            lines.append(f'{self.name}_bucket{suffix}le="{edge:g}"}} {cum}')
+        lines.append(f'{self.name}_bucket{suffix}le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum{base} {s:g}")
+        lines.append(f"{self.name}_count{base} {total}")
         return lines
 
 
@@ -175,20 +247,30 @@ class MetricsRegistry:
             self._instruments[inst.name] = inst
         return inst
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._register(Counter(name, help))
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._register(Counter(name, help, labels=labels))
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._register(Gauge(name, help))
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._register(Gauge(name, help, labels=labels))
 
     def histogram(self, name: str, help: str = "",
                   buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
-                  reservoir: int = 4096) -> Histogram:
-        return self._register(Histogram(name, help, buckets, reservoir))
+                  reservoir: int = 4096,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._register(Histogram(name, help, buckets, reservoir,
+                                        labels=labels))
 
     def get(self, name: str):
         with self._lock:
             return self._instruments.get(name)
+
+    def items(self):
+        """Snapshot of (name, instrument) pairs (the debug surfaces walk
+        this for exemplars)."""
+        with self._lock:
+            return list(self._instruments.items())
 
     def render_text(self) -> str:
         with self._lock:

@@ -42,6 +42,8 @@ from typing import Dict, Iterable, Optional
 from raft_stereo_tpu.telemetry.events import EventLog
 from raft_stereo_tpu.telemetry.registry import (DEFAULT_LATENCY_BUCKETS,
                                                 MetricsRegistry)
+from raft_stereo_tpu.telemetry.spans import SpanTracer
+from raft_stereo_tpu.telemetry.watchdog import AnomalySink, NonFiniteSentinel
 
 log = logging.getLogger(__name__)
 
@@ -119,12 +121,33 @@ class TrainTelemetry:
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 events: Optional[EventLog] = None):
+                 events: Optional[EventLog] = None,
+                 tracer: Optional[SpanTracer] = None,
+                 recorder=None, stall_watchdog=None):
         r = registry or MetricsRegistry()
         self.registry = r
         self.events = events
+        # Span tracer (telemetry/spans.py): default sampling 0.0 — every
+        # span site below takes the constant-time None exit, preserving the
+        # zero-extra-work guarantee of the PR 3 instrumentation.
+        self.tracer = tracer if tracer is not None else SpanTracer(0.0)
+        # Flight recorder + anomaly plumbing (telemetry/flight_recorder.py,
+        # telemetry/watchdog.py).  The non-finite sentinel rides the
+        # buffered metric drain — the means it inspects are ALREADY host
+        # floats, so detection adds zero device fetches.
+        self.recorder = recorder
+        if recorder is not None and events is not None:
+            events.add_sink(recorder.record_event)
+        self.stall_watchdog = stall_watchdog
+        self.anomaly_sink = AnomalySink(events=events, recorder=recorder)
+        self.nonfinite = NonFiniteSentinel(self.anomaly_sink)
+        self._trace = None  # the most recent sampled step's Trace
         self.steps = r.counter(
             "train_steps_total", "optimization steps completed this run")
+        self.anomalies = r.counter(
+            "train_anomalies_total",
+            "anomalies detected (non-finite metrics, step stalls)")
+        self.anomaly_sink.counter = self.anomalies
         self.recompiles = r.counter(
             "train_recompiles_total",
             "XLA backend compilations observed AFTER step 1 (step-0 "
@@ -220,8 +243,29 @@ class TrainTelemetry:
         self._in_step = False
         self.steps.inc()
         self.step_gauge.set(step)
-        self.data_wait.observe(data_wait_s)
-        self.step_time.observe(dispatch_s)
+        # Per-step trace (telemetry/spans.py), reconstructed RETROACTIVELY
+        # from the durations the loop already clocked — sampling a step
+        # adds span-object bookkeeping but no extra clock reads or fetches
+        # in the loop itself, and sampling 0 (default) skips even that.
+        trace = None
+        if self.tracer.enabled:
+            trace = self.tracer.start_trace()
+            if trace is not None:
+                t_end = time.perf_counter()
+                t_dispatch = t_end - dispatch_s
+                t_wait = t_dispatch - data_wait_s
+                trace.root = self.tracer.add_span(
+                    "train.step", trace, t_wait, t_end, step=step)
+                self.tracer.add_span("train.data_wait", trace,
+                                     t_wait, t_dispatch)
+                self.tracer.add_span("train.dispatch", trace,
+                                     t_dispatch, t_end)
+        self._trace = trace
+        exemplar = trace.trace_id if trace is not None else None
+        self.data_wait.observe(data_wait_s, exemplar=exemplar)
+        self.step_time.observe(dispatch_s, exemplar=exemplar)
+        if self.stall_watchdog is not None:
+            self.stall_watchdog.note_step(step)
         now = time.time()
         self.last_step_unix.set(now)
         with self._lock:
@@ -237,8 +281,19 @@ class TrainTelemetry:
     def observe_drain(self, seconds: float, means: Dict[str, float],
                       step: int, window: int) -> None:
         """Called after each SUM_FREQ metric fetch with the window's mean
-        scalars; also the refresh point for throughput + memory gauges."""
-        self.drain_time.observe(seconds)
+        scalars; also the refresh point for throughput + memory gauges,
+        the attach point for the drain span, and the non-finite sentinel's
+        inspection point (``means`` is already host floats — the check
+        costs zero device fetches)."""
+        trace = self._trace
+        if trace is not None:
+            t_end = time.perf_counter()
+            self.tracer.add_span("train.metric_drain", trace,
+                                 t_end - seconds, t_end,
+                                 step=step, window=window)
+        self.drain_time.observe(
+            seconds, exemplar=trace.trace_id if trace is not None else None)
+        self.nonfinite.check(means, step)
         now = time.monotonic()
         with self._lock:
             elapsed = now - self._last_drain_mono
@@ -275,6 +330,12 @@ class TrainTelemetry:
     def observe_checkpoint(self, seconds: float, path: str,
                            step: int) -> None:
         self.checkpoints.inc()
+        trace = self._trace
+        if trace is not None:
+            t_end = time.perf_counter()
+            self.tracer.add_span("train.checkpoint", trace,
+                                 t_end - seconds, t_end,
+                                 step=step, path=path)
         self.checkpoint_time.observe(seconds)
         if self.events is not None:
             self.events.emit("checkpoint", step=step, path=path,
@@ -301,8 +362,19 @@ class TrainTelemetry:
         if self._armed:
             _set_active_detector(None)
             self._armed = False
+        if self.stall_watchdog is not None:
+            self.stall_watchdog.stop()  # a finished run must not page
         if self.events is not None:
             self.events.emit("run_end", status=status, step=step)
+
+    def enable_stall_watchdog(self, **kw) -> "object":
+        """Create + start a ``StepStallWatchdog`` wired into this run's
+        anomaly sink (cli/train.py calls this when the watchdog flag is
+        on); ``observe_step`` feeds it heartbeats, ``run_end`` stops it."""
+        from raft_stereo_tpu.telemetry.watchdog import StepStallWatchdog
+        self.stall_watchdog = StepStallWatchdog(self.anomaly_sink,
+                                                **kw).start()
+        return self.stall_watchdog
 
     # ------------------------------------------------------------- scrapes
     def healthz(self) -> Dict[str, object]:
@@ -318,6 +390,7 @@ class TrainTelemetry:
         out["last_step_age_s"] = (round(time.monotonic() - last, 3)
                                   if last is not None else None)
         out["recompiles"] = self.recompiles.value
+        out["anomalies"] = self.anomalies.value
         return out
 
     # ------------------------------------------------- compile-event sink

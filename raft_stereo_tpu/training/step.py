@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_stereo_tpu.config import TrainConfig
@@ -78,7 +79,13 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
     (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params)
     new_state = state.apply_gradients(grads=grads)
-    metrics = dict(metrics, loss=loss)
+    # Global gradient norm rides the metrics dict: the optimizer computes
+    # the same reduction for clipping (XLA dedups it), it reaches the host
+    # through the existing buffered drain — no extra sync — and it is the
+    # grad half of the non-finite sentinel (telemetry/watchdog.py): a
+    # diverging run's grad_norm goes non-finite a window before the loss
+    # does when clipping masks the blow-up.
+    metrics = dict(metrics, loss=loss, grad_norm=optax.global_norm(grads))
     return new_state, metrics
 
 

@@ -171,10 +171,15 @@ def train(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     config captured at CLI time could be stale).
     ``loader`` overrides dataset construction (used by tests).
     ``telemetry`` is an optional ``telemetry.TrainTelemetry``: step-time
-    split, memory gauges, recompile detection, and structured run events
-    (cli/train.py builds one for --metrics_port).  When None — the default
-    — the loop takes the exact pre-telemetry path: no extra timing calls,
-    no extra device fetches (tests/test_telemetry.py pins this).
+    split, memory gauges, recompile detection, structured run events, and
+    — layer 2 — per-step span traces (reconstructed from the timings this
+    loop already clocks; TrainConfig.trace_sample_rate), a non-finite
+    loss/grad sentinel riding the buffered metric drain, a step-stall
+    watchdog, and a flight recorder that bundles the evidence on anomaly
+    (cli/train.py wires all of it for --metrics_port).  When None — the
+    default — the loop takes the exact pre-telemetry path: no extra
+    timing calls, no extra device fetches (tests/test_telemetry.py and
+    tests/test_observability.py pin this).
     """
     # Defensive: form the process group (no-op single-host / already done)
     # BEFORE the jax.devices() call below latches the backend.

@@ -1,13 +1,19 @@
 #!/usr/bin/env python
 """CI smoke: boot the training metrics endpoint for a 5-step CPU run and
-assert ``/metrics`` and ``/healthz`` answer with live data.
+assert ``/metrics``, ``/healthz``, ``/debug/spans``, and ``/debug/stacks``
+answer with live data.
 
 This is the acceptance check for the telemetry subsystem wired end to end —
-TrainTelemetry instruments → train loop → TelemetryHTTPServer — on the same
-synthetic-loader path the hermetic tests use (no datasets, no accelerator).
-Exit 0 on success, non-zero with a diagnostic on any failed assertion.
+TrainTelemetry instruments + span tracer + flight recorder → train loop →
+TelemetryHTTPServer — on the same synthetic-loader path the hermetic tests
+use (no datasets, no accelerator).  Exit 0 on success, non-zero with a
+diagnostic on any failed assertion; on failure a flight-recorder debug
+bundle is dumped under the output directory so CI can upload it as an
+artifact (ci.yml).
 
 Run from the repo root:  JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
+The output directory defaults to a temp dir; set SMOKE_OUT to pin it
+(CI pins ``smoke-debug`` and uploads it when this script fails).
 """
 
 from __future__ import annotations
@@ -44,16 +50,25 @@ def main() -> int:
 
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
     from raft_stereo_tpu.data.loader import StereoLoader
-    from raft_stereo_tpu.telemetry import (EventLog, TelemetryHTTPServer,
+    from raft_stereo_tpu.telemetry import (EventLog, FlightRecorder,
+                                           SpanTracer, TelemetryHTTPServer,
                                            TrainTelemetry, replay)
     from raft_stereo_tpu.training.train_loop import train
 
-    tmp = tempfile.mkdtemp(prefix="metrics_smoke_")
+    tmp = os.environ.get("SMOKE_OUT") or tempfile.mkdtemp(
+        prefix="metrics_smoke_")
+    os.makedirs(tmp, exist_ok=True)
     events = EventLog(os.path.join(tmp, "events.jsonl"))
-    telemetry = TrainTelemetry(events=events)
+    tracer = SpanTracer(1.0)              # smoke samples every step
+    recorder = FlightRecorder(os.path.join(tmp, "flightrecorder"),
+                              tracer=tracer, min_interval_s=0.0)
+    telemetry = TrainTelemetry(events=events, tracer=tracer,
+                               recorder=recorder)
+    recorder.registry = telemetry.registry
     server = TelemetryHTTPServer(telemetry.registry, telemetry.healthz,
-                                 port=0).start()
-    print(f"metrics endpoint: {server.url}")
+                                 port=0, tracer=tracer,
+                                 recorder=recorder).start()
+    print(f"metrics endpoint: {server.url} (artifacts: {tmp})")
 
     # InstanceNorm's optimization_barrier has no CPU differentiation rule
     # in some jax versions, hence fnet_norm="none" (the hermetic tests'
@@ -63,7 +78,7 @@ def main() -> int:
     train_cfg = TrainConfig(batch_size=2, train_iters=2,
                             num_steps=NUM_STEPS, image_size=(32, 64),
                             validation_frequency=10_000, data_parallel=1,
-                            gru_telemetry=True)
+                            gru_telemetry=True, trace_sample_rate=1.0)
     loader = StereoLoader(_SyntheticDataset(), batch_size=2, num_workers=0,
                           shuffle=False)
     try:
@@ -77,6 +92,7 @@ def main() -> int:
                                          timeout=10).read().decode()
         for needle in (f"train_steps_total {NUM_STEPS}",
                        "train_recompiles_total 0",
+                       "train_anomalies_total 0",
                        f"train_step_seconds_count {NUM_STEPS}",
                        f"train_data_wait_seconds_count {NUM_STEPS}",
                        "train_gru_delta_px_count"):
@@ -87,10 +103,41 @@ def main() -> int:
         assert health["status"] == "complete", health
         assert health["step"] == NUM_STEPS, health
         assert health["last_step_age_s"] is not None, health
+        assert health["anomalies"] == 0, health
+
+        # Span tracing end to end: every step's trace is in the ring and
+        # the export is Chrome trace-event JSON Perfetto can open.
+        chrome = json.load(urllib.request.urlopen(
+            server.url + "/debug/spans", timeout=10))
+        steps = [e for e in chrome["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "train.step"]
+        assert len(steps) == NUM_STEPS, f"{len(steps)} step spans"
+        names = {e["name"] for e in chrome["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert {"train.data_wait", "train.dispatch",
+                "train.metric_drain", "train.checkpoint"} <= names, names
+        with open(os.path.join(tmp, "trace.json"), "w") as f:
+            json.dump(chrome, f)
+
+        stacks = urllib.request.urlopen(server.url + "/debug/stacks",
+                                        timeout=10).read().decode()
+        assert "MainThread" in stacks, stacks[:200]
+
+        fr = json.load(urllib.request.urlopen(
+            server.url + "/debug/flightrecorder", timeout=10))
+        assert fr["dumps"] == 0, fr  # healthy run: nothing triggered
+        assert fr["spans"]["ring_size"] >= NUM_STEPS, fr
 
         kinds = [e["event"] for e in replay(events.path)]
         assert kinds[0] == "run_start" and kinds[-1] == "run_end", kinds
         assert "step_stats" in kinds and "checkpoint" in kinds, kinds
+    except BaseException:
+        # Leave the evidence where ci.yml uploads it from.
+        try:
+            recorder.dump("smoke_failure", force=True)
+        except Exception:
+            pass
+        raise
     finally:
         server.shutdown()
         events.close()

@@ -1,0 +1,436 @@
+"""Observability layer 2 (ISSUE 4): span tracing, flight recorder, anomaly
+watchdogs — and the acceptance guarantees: zero device-fetch overhead when
+disabled, a Perfetto-valid Chrome trace from a sampled run, and an
+injected-NaN run producing a debug bundle + ``anomaly`` event."""
+
+import json
+import logging
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
+from raft_stereo_tpu.telemetry import (ANOMALY_VERSION, AnomalySink,
+                                       EventLog, FlightRecorder,
+                                       MetricsRegistry, NonFiniteSentinel,
+                                       SpanTracer, StepStallWatchdog,
+                                       TrainTelemetry, dump_all_stacks,
+                                       escape_label_value, replay,
+                                       to_chrome_trace,
+                                       unescape_label_value)
+
+
+# ------------------------------------------------------------ span tracer
+def test_tracer_disabled_is_noop():
+    t = SpanTracer(0.0)
+    assert not t.enabled
+    assert t.start_trace("x") is None
+    with t.span("y") as s:
+        assert s is None
+    assert t.start_span("z", None) is None
+    assert t.add_span("w", None, 0.0, 1.0) is None
+    assert t.spans() == []
+
+
+def test_tracer_span_tree_and_nesting():
+    t = SpanTracer(1.0)
+    tr = t.start_trace("root", kind="test")
+    assert tr is not None and tr.root is not None
+    with t.span("outer", tr) as outer:
+        with t.span("inner", tr) as inner:
+            assert inner.parent_id == outer.span_id
+        assert outer.parent_id == tr.root.span_id
+    t.finish_trace(tr)
+    spans = {s.name: s for s in t.spans()}
+    assert set(spans) == {"root", "outer", "inner"}
+    assert spans["root"].attrs["kind"] == "test"
+    assert all(s.trace_id == tr.trace_id for s in spans.values())
+    assert spans["root"].t_end >= spans["root"].t_start
+
+
+def test_tracer_sampling_rate_and_ring_bound():
+    t = SpanTracer(0.5, ring=8, seed=7)
+    traces = [t.start_trace("r") for _ in range(200)]
+    sampled = [tr for tr in traces if tr is not None]
+    # seeded rng: deterministic, and a 0.5 rate lands well inside (25, 175)
+    assert 25 < len(sampled) < 175
+    for tr in sampled:
+        t.finish_trace(tr)
+    assert len(t.spans()) <= 8      # ring bound holds
+    stats = t.stats()
+    assert stats["traces_started"] == 200
+    assert stats["traces_sampled"] == len(sampled)
+    with pytest.raises(ValueError):
+        SpanTracer(1.5)
+
+
+def test_chrome_trace_export_is_valid_and_complete():
+    t = SpanTracer(1.0)
+    tr = t.start_trace("req", bucket="(64, 64)")
+    s = t.start_span("queue", tr, batch_size=3)
+    time.sleep(0.002)
+    t.finish(s)
+    t.finish_trace(tr)
+    out = to_chrome_trace(t.spans())
+    parsed = json.loads(json.dumps(out))   # valid JSON round trip
+    events = parsed["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"req", "queue"}
+    for e in xs:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert e["args"]["trace_id"] == tr.trace_id
+    queue = next(e for e in xs if e["name"] == "queue")
+    assert queue["args"]["parent_id"] == tr.root.span_id
+    assert queue["args"]["batch_size"] == 3
+    # metadata rows name the process and each thread lane
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in events)
+
+
+# -------------------------------------------- registry escaping (satellite)
+def test_exposition_escapes_label_values_and_help():
+    nasty = 'back\\slash "quote"\nnewline'
+    reg = MetricsRegistry()
+    reg.counter("c_total", "help with \\ and\nnewline",
+                labels={"dev": nasty}).inc(2)
+    reg.histogram("h_seconds", "h", buckets=(1.0,),
+                  labels={"k": nasty}).observe(0.5)
+    text = reg.render_text()
+    # no raw newline may survive inside any single exposition line
+    for line in text.splitlines():
+        assert "\n" not in line
+    sample = next(l for l in text.splitlines() if l.startswith("c_total{"))
+    start = sample.index('dev="') + len('dev="')
+    end = sample.rindex('"')
+    assert unescape_label_value(sample[start:end]) == nasty  # round trip
+    assert r"\n" in text and r"\\" in text
+    # histogram: constant labels merge with le on every bucket line
+    assert 'le="1"' in text and 'le="+Inf"' in text
+    bucket_line = next(l for l in text.splitlines()
+                       if l.startswith("h_seconds_bucket"))
+    assert 'k="' in bucket_line and 'le="' in bucket_line
+
+
+def test_escape_label_value_round_trip_edge_cases():
+    for v in ("", "\\", '"', "\n", "\\n", '\\"', 'a\\b"c\nd', "\\\\\n\""):
+        assert unescape_label_value(escape_label_value(v)) == v
+
+
+def test_histogram_exemplars_bounded():
+    from raft_stereo_tpu.telemetry.registry import EXEMPLAR_RING
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "l", buckets=(1.0,))
+    h.observe(0.5)                       # no exemplar
+    for i in range(EXEMPLAR_RING + 5):
+        h.observe(0.1 * i, exemplar=f"trace{i}")
+    ex = h.exemplars()
+    assert len(ex) == EXEMPLAR_RING      # bounded ring
+    assert ex[-1]["trace_id"] == f"trace{EXEMPLAR_RING + 4}"
+    assert ex[-1]["value"] == pytest.approx(0.1 * (EXEMPLAR_RING + 4))
+
+
+# ------------------------------------------------ torn-tail replay warning
+def test_replay_warns_on_torn_tail_and_midfile_corruption(tmp_path, caplog):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as ev:
+        ev.emit("run_start", name="x")
+        ev.emit("step_stats", step=1)
+    with open(path, "a") as f:
+        f.write('{"event": "torn')       # SIGKILL mid-write, no newline
+    with caplog.at_level(logging.WARNING,
+                         logger="raft_stereo_tpu.telemetry.events"):
+        recs = list(replay(path))
+    assert [r["event"] for r in recs] == ["run_start", "step_stats"]
+    assert "torn final line" in caplog.text
+    caplog.clear()
+
+    # mid-file corruption: the earlier records AND the later ones survive
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "a"}) + "\n")
+        f.write("<<corrupt>>\n")
+        f.write(json.dumps({"event": "b"}) + "\n")
+    with caplog.at_level(logging.WARNING,
+                         logger="raft_stereo_tpu.telemetry.events"):
+        recs = list(replay(path))
+    assert [r["event"] for r in recs] == ["a", "b"]
+    assert "mid-file corruption" in caplog.text
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_recorder_bundle_contents(tmp_path):
+    tracer = SpanTracer(1.0)
+    tr = tracer.start_trace("req")
+    tracer.finish_trace(tr)
+    reg = MetricsRegistry()
+    reg.counter("x_total", "t").inc(3)
+    rec = FlightRecorder(str(tmp_path / "fr"), tracer=tracer, registry=reg,
+                         min_interval_s=0.0)
+    rec.record_event({"event": "step_stats", "step": 1})
+    bundle = rec.dump("test_trigger", detail={"why": "unit test"})
+    assert bundle is not None
+    names = set(os.listdir(bundle))
+    assert {"manifest.json", "trace.json", "spans.jsonl", "events.jsonl",
+            "metrics.prom", "stacks.txt", "device_memory.json"} <= names
+    manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert manifest["trigger"] == "test_trigger"
+    assert manifest["detail"]["why"] == "unit test"
+    assert manifest["n_spans"] == 1 and manifest["n_events"] == 1
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    assert any(e.get("name") == "req" for e in trace["traceEvents"])
+    with open(os.path.join(bundle, "events.jsonl")) as f:
+        evs = [json.loads(l) for l in f]
+    assert evs[0]["event"] == "step_stats"
+    assert "x_total 3" in open(os.path.join(bundle, "metrics.prom")).read()
+    stacks = open(os.path.join(bundle, "stacks.txt")).read()
+    assert "MainThread" in stacks and "test_flight_recorder" in stacks
+    status = rec.status()
+    assert status["dumps"] == 1 and status["bundles"] == [bundle]
+
+
+def test_flight_recorder_rate_limit(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "fr"), min_interval_s=60.0)
+    assert rec.dump("first") is not None
+    assert rec.dump("second") is None            # suppressed
+    assert rec.dump("forced", force=True) is not None
+    assert rec.status()["dumps"] == 2
+
+
+def test_dump_all_stacks_sees_all_threads():
+    import threading
+
+    done = threading.Event()
+    t = threading.Thread(target=done.wait, name="stackdump-probe",
+                         daemon=True)
+    t.start()
+    try:
+        out = dump_all_stacks()
+        assert "stackdump-probe" in out
+        assert "MainThread" in out
+    finally:
+        done.set()
+
+
+# -------------------------------------------------------------- watchdogs
+def test_nonfinite_sentinel_rearms_after_recovery(tmp_path):
+    events = EventLog(str(tmp_path / "e.jsonl"))
+    rec = FlightRecorder(str(tmp_path / "fr"), min_interval_s=0.0)
+    sink = AnomalySink(events=events, recorder=rec)
+    s = NonFiniteSentinel(sink)
+    assert s.check({"loss": float("nan"), "epe": 1.0}, step=3)
+    assert not s.check({"loss": float("nan")}, step=4)   # latched
+    assert not s.check({"loss": 0.5}, step=5)            # recovery re-arms
+    assert s.check({"loss": float("inf")}, step=6)
+    events.close()
+    recs = [r for r in replay(events.path) if r["event"] == "anomaly"]
+    assert len(recs) == 2
+    assert recs[0]["anomaly_version"] == ANOMALY_VERSION
+    assert recs[0]["kind"] == "non_finite_metric"
+    assert recs[0]["step"] == 3 and "loss" in recs[0]["metrics"]
+    assert recs[0]["bundle"] is not None
+    assert sink.anomalies == 2
+
+
+def test_step_stall_watchdog_fires_on_stall():
+    sink = AnomalySink()
+    wd = StepStallWatchdog(sink, factor=1.0, min_stall_s=0.05)
+    assert not wd.check()                # no baseline yet -> silent
+    wd.note_step(1)
+    assert not wd.check()                # still no interval
+    wd.note_step(2)                      # first interval (~0) -> floor rules
+    assert wd.threshold_s() == pytest.approx(0.05)
+    time.sleep(0.1)
+    assert wd.check()                    # stalled past the floor
+    assert not wd.check()                # latched until progress
+    wd.note_step(3)                      # progress re-arms
+    assert not wd.check()
+    time.sleep(0.25)                     # median is now ~0.1s
+    assert wd.check()
+    assert sink.anomalies == 2
+
+
+def test_serving_watchdog_detectors():
+    from raft_stereo_tpu.serving.metrics import ServingMetrics
+    from raft_stereo_tpu.telemetry import ServingWatchdog
+
+    m = ServingMetrics()
+    sink = AnomalySink(counter=m.anomalies)
+    wd = ServingWatchdog(sink, m, max_queue=10, saturation=0.8,
+                         sustain_s=0.02, miss_rate=0.5, min_events=4)
+    # queue saturation must SUSTAIN before firing
+    m.queue_depth.set(9)
+    assert wd.check() == []
+    time.sleep(0.03)
+    assert wd.check() == ["queue_saturation"]
+    assert wd.check() == []              # latched
+    m.queue_depth.set(1)
+    assert wd.check() == []              # clears + re-arms
+    # deadline-miss rate over a poll window
+    m.admitted.inc(10)
+    m.deadline_missed.inc(6)
+    assert wd.check() == ["deadline_miss_rate"]
+    m.admitted.inc(10)
+    m.deadline_missed.inc(6)
+    assert wd.check() == []              # latched while rate stays high
+    m.admitted.inc(10)                   # healthy window re-arms
+    assert wd.check() == []
+    m.admitted.inc(10)
+    m.deadline_missed.inc(9)
+    assert wd.check() == ["deadline_miss_rate"]
+    assert m.anomalies.value == 3
+
+
+# ---------------------------------------- instrumented runs (CPU, 5 steps)
+class _SyntheticDataset:
+    """Tiny synthetic stereo batches; ``nan_from`` poisons the flow GT of
+    later items so the loss goes non-finite mid-run (the injected-NaN
+    acceptance scenario)."""
+
+    def __init__(self, nan_from=None):
+        self.nan_from = nan_from
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i, epoch=0):
+        img = np.full((32, 64, 3), float(i), np.float32)
+        flow = np.full((32, 64), -2.0, np.float32)
+        if self.nan_from is not None and i >= self.nan_from:
+            flow[:] = np.nan
+        return {"image1": img, "image2": img, "flow": flow,
+                "valid": np.ones((32, 64), np.float32)}
+
+
+def _run_train(tmp_path, telemetry_obj, num_steps=5, train_iters=2,
+               nan_from=None):
+    from raft_stereo_tpu.data.loader import StereoLoader
+    from raft_stereo_tpu.training.train_loop import train
+
+    mcfg = RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,), fnet_dim=64,
+                            fnet_norm="none")
+    tcfg = TrainConfig(batch_size=2, train_iters=train_iters,
+                       num_steps=num_steps, image_size=(32, 64),
+                       validation_frequency=10_000, data_parallel=1,
+                       gru_telemetry=False)
+    loader = StereoLoader(_SyntheticDataset(nan_from=nan_from), batch_size=2,
+                          num_workers=0, shuffle=False)
+    return train(mcfg, tcfg, name="obs", checkpoint_dir=str(tmp_path / "ck"),
+                 log_dir=str(tmp_path / "runs"), loader=loader,
+                 use_mesh=False, telemetry=telemetry_obj)
+
+
+@pytest.fixture(scope="module")
+def nan_run(tmp_path_factory):
+    """ONE fully-instrumented injected-NaN run, sampling 1.0: the flight
+    recorder, watchdog, and span assertions below share it."""
+    tmp_path = tmp_path_factory.mktemp("nan_run")
+    events = EventLog(str(tmp_path / "events.jsonl"))
+    tracer = SpanTracer(1.0)
+    recorder = FlightRecorder(str(tmp_path / "fr"), tracer=tracer,
+                              min_interval_s=0.0)
+    tm = TrainTelemetry(events=events, tracer=tracer, recorder=recorder)
+    recorder.registry = tm.registry
+    state = _run_train(tmp_path, tm, num_steps=5, nan_from=2)
+    events.close()
+    return dict(state=state, telemetry=tm, tracer=tracer, recorder=recorder,
+                events_path=events.path)
+
+
+def test_injected_nan_produces_bundle_and_anomaly_event(nan_run):
+    """Acceptance: a non-finite loss on CPU produces a flight-recorder
+    bundle plus an ``anomaly`` event in the run-event log."""
+    recs = list(replay(nan_run["events_path"]))
+    anomalies = [r for r in recs if r["event"] == "anomaly"]
+    assert anomalies, "injected NaN must emit an anomaly event"
+    a = anomalies[0]
+    assert a["kind"] == "non_finite_metric"
+    assert a["anomaly_version"] == ANOMALY_VERSION
+    assert "loss" in a["metrics"]
+    assert a["bundle"] is not None and os.path.isdir(a["bundle"])
+    # event ordering stays coherent around the anomaly
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert nan_run["telemetry"].anomalies.value >= 1
+    assert nan_run["telemetry"].healthz()["anomalies"] >= 1
+
+
+def test_nan_run_bundle_replays_and_trace_parses(nan_run):
+    """Satellite: the bundle's span ring replays cleanly and its Chrome
+    trace JSON parses."""
+    bundle = nan_run["recorder"].bundles[0]
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert "train.step" in names
+    assert {"train.data_wait", "train.dispatch"} <= names
+    with open(os.path.join(bundle, "spans.jsonl")) as f:
+        spans = [json.loads(l) for l in f]
+    assert spans and all(
+        {"name", "trace_id", "span_id", "start_us", "duration_us"}
+        <= set(s) for s in spans)
+    # events ring replay: the same records the event log holds
+    with open(os.path.join(bundle, "events.jsonl")) as f:
+        evs = [json.loads(l) for l in f]
+    assert evs[0]["event"] == "run_start"
+    assert "metrics.prom" in os.listdir(bundle)
+    assert "train_steps_total" in open(
+        os.path.join(bundle, "metrics.prom")).read()
+
+
+def test_train_step_span_trees_are_complete(nan_run):
+    """Sampling 1.0: every step contributes a step trace whose data-wait /
+    dispatch children parent to the step root, plus drain + checkpoint
+    spans on the final step's trace."""
+    spans = nan_run["tracer"].spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    assert len(by_name["train.step"]) == 5
+    assert len(by_name["train.data_wait"]) == 5
+    assert len(by_name["train.dispatch"]) == 5
+    assert by_name.get("train.metric_drain"), "final drain must be spanned"
+    assert by_name.get("train.checkpoint"), "checkpoint must be spanned"
+    roots = {s.span_id: s for s in by_name["train.step"]}
+    for child in by_name["train.data_wait"] + by_name["train.dispatch"]:
+        root = roots[child.parent_id]
+        assert root.trace_id == child.trace_id
+        assert root.t_start <= child.t_start + 1e-6
+        assert child.t_end <= root.t_end + 1e-6
+    # steps are distinct traces
+    assert len({s.trace_id for s in by_name["train.step"]}) == 5
+    # exemplars link the latency histograms back to these traces
+    ex = nan_run["telemetry"].step_time.exemplars()
+    assert ex and all(e["trace_id"] in {s.trace_id for s in spans}
+                      for e in ex)
+
+
+def test_spans_sampling_zero_adds_no_device_fetches(tmp_path, monkeypatch):
+    """Acceptance: the train loop with telemetry + spans wired at sampling
+    0 issues EXACTLY the ``jax.device_get`` calls the fully-disabled loop
+    issues — the PR 3 zero-overhead guarantee extends to the span layer."""
+    real_device_get = jax.device_get
+    counts = []
+
+    def run_counting(telemetry_obj, sub):
+        calls = [0]
+
+        def counting_get(x):
+            calls[0] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        try:
+            _run_train(tmp_path / sub, telemetry_obj, num_steps=2,
+                       train_iters=1)
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_device_get)
+        counts.append(calls[0])
+
+    run_counting(None, "off")
+    tm = TrainTelemetry(tracer=SpanTracer(0.0))
+    run_counting(tm, "spans0")
+    assert counts[0] == counts[1], counts
+    assert tm.tracer.spans() == []       # sampling 0 recorded nothing

@@ -447,6 +447,15 @@ def test_http_disparity_npz_to_npy_and_metrics(http_server, tiny_model):
 
     cfg, variables = tiny_model
     lefts, rights = _pairs(1)
+
+    # Before any traffic: healthz answers, last-batch age is null (an
+    # idle-from-boot service is idle, not stale).
+    with urllib.request.urlopen(http_server.url + "/healthz",
+                                timeout=30) as resp:
+        health = json.loads(resp.read())
+    assert health["status"] == "ok"
+    assert health["last_batch_age_s"] is None
+
     buf = io.BytesIO()
     np.savez(buf, left=lefts[0], right=rights[0])
     status, headers, body = _post(http_server.url + "/v1/disparity",
@@ -462,11 +471,18 @@ def test_http_disparity_npz_to_npy_and_metrics(http_server, tiny_model):
         text = resp.read().decode()
     assert "serve_requests_completed_total 1" in text
     assert "serve_total_latency_seconds_count 1" in text
+    assert "serve_last_batch_unix_seconds" in text
 
+    # Satellite (ISSUE 4): healthz matches the train endpoint's shape —
+    # status, queue depth, inflight count, last-batch age.
     with urllib.request.urlopen(http_server.url + "/healthz",
                                 timeout=30) as resp:
         health = json.loads(resp.read())
     assert health["status"] == "ok" and health["devices"] == 1
+    assert health["queue_depth"] == 0 and health["inflight"] == 0
+    assert health["last_batch_age_s"] is not None
+    assert 0 <= health["last_batch_age_s"] < 600
+    assert health["anomalies"] == 0
 
 
 def test_http_png_pair_roundtrip(http_server):
@@ -502,3 +518,172 @@ def test_http_error_mapping(http_server):
     status, _, _ = _post(http_server.url + "/v1/disparity?format=tiff",
                          buf.getvalue())
     assert status == 400
+
+
+# -------------------------------------------- request-path tracing (ISSUE 4)
+def test_served_request_span_tree_under_full_sampling(tiny_model):
+    """Acceptance: a served request under sampling=1.0 yields a span tree
+    covering admission -> queue -> dispatch -> fetch whose export is valid
+    Chrome trace-event JSON with the documented attributes."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.telemetry import to_chrome_trace
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(2)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, max_wait_ms=30, iters=ITERS,
+                                   trace_sample_rate=1.0)) as svc:
+        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+        results = [f.result(timeout=120) for f in futures]
+        assert all(np.isfinite(r.flow).all() for r in results)
+        spans = svc.tracer.spans()
+        tracer = svc.tracer
+
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, {})[s.name] = s
+    assert len(by_trace) == 2            # one trace per request
+    for tree in by_trace.values():
+        assert {"serve.request", "serve.admission", "serve.queue",
+                "serve.dispatch", "serve.fetch",
+                "serve.respond"} <= set(tree)
+        root = tree["serve.request"]
+        assert root.parent_id is None
+        assert root.attrs["status"] == "ok"
+        for name in ("serve.admission", "serve.queue", "serve.dispatch",
+                     "serve.fetch", "serve.respond"):
+            assert tree[name].parent_id == root.span_id, name
+        # causality: admission -> queue -> dispatch -> fetch in time order
+        assert (tree["serve.admission"].t_start <= tree["serve.queue"].t_start
+                <= tree["serve.dispatch"].t_start
+                <= tree["serve.fetch"].t_start)
+        assert tree["serve.dispatch"].attrs["batch_size"] == 2
+        assert tree["serve.dispatch"].attrs["bucket"] == "(64, 64)"
+        assert "device" in tree["serve.dispatch"].attrs
+        assert tree["serve.queue"].attrs["batch_size"] == 2
+
+    chrome = json.loads(json.dumps(to_chrome_trace(spans)))
+    xs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert {"serve.request", "serve.queue", "serve.dispatch",
+            "serve.fetch"} <= {e["name"] for e in xs}
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in xs)
+
+    # exemplars on the latency histograms point back at the sampled traces
+    ex = [e["trace_id"] for e in svc.metrics.total_latency.exemplars()]
+    assert set(ex) == set(by_trace)
+    assert tracer.stats()["traces_sampled"] == 2
+
+
+def test_serving_default_has_tracing_off(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, max_wait_ms=1.0,
+                                   iters=ITERS)) as svc:
+        assert not svc.tracer.enabled
+        svc.infer(lefts[0], rights[0], timeout=120)
+        assert svc.tracer.spans() == []
+        assert svc.metrics.total_latency.exemplars() == []
+    with pytest.raises(ValueError, match="trace_sample_rate"):
+        ServeConfig(trace_sample_rate=1.5)
+
+
+@pytest.fixture()
+def debug_http_server(tiny_model, tmp_path):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+    from raft_stereo_tpu.telemetry import FlightRecorder
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=2, max_wait_ms=5.0,
+                                    iters=ITERS, trace_sample_rate=1.0))
+    recorder = FlightRecorder(str(tmp_path / "fr"), tracer=svc.tracer,
+                              registry=svc.metrics.registry,
+                              min_interval_s=0.0)
+    server = StereoHTTPServer(svc, port=0, recorder=recorder).start()
+    yield server
+    server.shutdown()
+    svc.close()
+
+
+def test_http_debug_surface(debug_http_server):
+    """GET /debug/spans (Chrome trace JSON), /debug/stacks, and GET/POST
+    /debug/flightrecorder on the serving endpoint."""
+    url = debug_http_server.url
+    lefts, rights = _pairs(1)
+    buf = io.BytesIO()
+    np.savez(buf, left=lefts[0], right=rights[0])
+    status, _, _ = _post(url + "/v1/disparity", buf.getvalue())
+    assert status == 200
+
+    with urllib.request.urlopen(url + "/debug/spans", timeout=30) as resp:
+        chrome = json.loads(resp.read())
+    names = {e["name"] for e in chrome["traceEvents"] if e["ph"] == "X"}
+    assert {"serve.request", "serve.queue", "serve.dispatch",
+            "serve.fetch"} <= names
+
+    with urllib.request.urlopen(url + "/debug/spans?exemplars=1",
+                                timeout=30) as resp:
+        wrapped = json.loads(resp.read())
+    assert wrapped["stats"]["traces_sampled"] >= 1
+    assert "serve_total_latency_seconds" in wrapped["exemplars"]
+    assert "traceEvents" in wrapped["trace"]
+
+    with urllib.request.urlopen(url + "/debug/stacks", timeout=30) as resp:
+        stacks = resp.read().decode()
+    assert "stereo-worker-0" in stacks and "MainThread" in stacks
+
+    with urllib.request.urlopen(url + "/debug/flightrecorder",
+                                timeout=30) as resp:
+        st = json.loads(resp.read())
+    assert st["dumps"] == 0 and st["spans"]["ring_size"] >= 4
+
+    req = urllib.request.Request(url + "/debug/flightrecorder", data=b"",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        reply = json.loads(resp.read())
+    assert reply["bundle"] is not None
+    bundle_trace = json.load(
+        open(reply["bundle"] + "/trace.json"))
+    assert "traceEvents" in bundle_trace
+    with urllib.request.urlopen(url + "/debug/flightrecorder",
+                                timeout=30) as resp:
+        st = json.loads(resp.read())
+    assert st["dumps"] == 1 and st["last_trigger"] == "manual"
+
+
+def test_serve_cli_wires_observability(tiny_model, tmp_path):
+    """cli.serve: --trace_sample_rate/--watchdog/--event_log build the
+    tracer + recorder + watchdog around the service."""
+    from raft_stereo_tpu.cli.serve import (build_observability, build_parser,
+                                           build_service)
+    from raft_stereo_tpu.training.checkpoint import save_weights
+
+    cfg, variables = tiny_model
+    path = str(tmp_path / "ckpt")
+    save_weights(path, cfg, variables["params"],
+                 variables.get("batch_stats"))
+    args = build_parser().parse_args(
+        ["--restore_ckpt", path, "--valid_iters", str(ITERS),
+         "--trace_sample_rate", "1.0", "--watchdog",
+         "--event_log", str(tmp_path / "serve-events.jsonl"),
+         "--flight_recorder_dir", str(tmp_path / "fr")])
+    svc = build_service(args)
+    events = recorder = watchdog = None
+    try:
+        assert svc.serve_cfg.trace_sample_rate == 1.0
+        assert svc.tracer.enabled
+        events, recorder, watchdog = build_observability(args, svc)
+        assert recorder is not None and watchdog is not None
+        lefts, rights = _pairs(1)
+        svc.infer(lefts[0], rights[0], timeout=120)
+        assert any(s.name == "serve.request" for s in svc.tracer.spans())
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        if events is not None:
+            events.close()
+        svc.close()
