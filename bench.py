@@ -111,6 +111,7 @@ def check_regression(split: dict, fps: float) -> list:
 def main():
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.telemetry.costs import aot_cost_summary
     from raft_stereo_tpu.telemetry.events import bench_record
 
     cfg = RaftStereoConfig.realtime()
@@ -130,6 +131,14 @@ def main():
                                      BENCH_ITERS)
     t_one = _seconds_per_forward(model, variables, img1, img2, 1)
     fps = 1.0 / per_image
+    # Cost denominator (telemetry/costs.py): the bench forward's compiled
+    # flops/bytes ride the record, so every BENCH_*.json carries the
+    # model-required work next to the measured time — measured seconds x
+    # this flops number over the device peak IS the bench's MFU.
+    cost = aot_cost_summary(
+        jax.jit(lambda v, a, b: model.apply(v, a, b, iters=BENCH_ITERS,
+                                            test_mode=True)[1]),
+        variables, img1, img2)
     # Shared versioned header (telemetry/events.py): schema_version + the
     # run's device topology/timestamp ride the primary record.
     print(json.dumps(bench_record({
@@ -137,7 +146,7 @@ def main():
         "value": round(fps, 2),
         "unit": "frames/s",
         "vs_baseline": round(fps / BASELINE_FPS, 3),
-    })))
+    }, cost=cost)))
     split = phase_split(per_image, t_one, BENCH_ITERS)
     split["fused_gru"] = cfg.fused_gru
     print(json.dumps(split))

@@ -38,7 +38,9 @@ def build_service(args):
         shape_bucket=args.shape_bucket,
         fetch_dtype=args.fetch_dtype,
         default_deadline_ms=args.deadline_ms,
-        trace_sample_rate=args.trace_sample_rate)
+        trace_sample_rate=args.trace_sample_rate,
+        cost_telemetry=args.cost_telemetry,
+        device_peak_tflops=args.device_peak_tflops)
     return StereoService(cfg, variables, serve_cfg)
 
 
@@ -64,6 +66,11 @@ def build_observability(args, service):
                            counter=service.metrics.anomalies)
         watchdog = ServingWatchdog(sink, service.metrics,
                                    max_queue=args.max_queue).start()
+    if events is not None and service.costs is not None:
+        # First compile of each bucket becomes a "compile" run event with
+        # its cost summary — the serving twin of the training compile
+        # events (telemetry/costs.CompileRegistry.record).
+        service.costs.events = events
     return events, recorder, watchdog
 
 
@@ -158,9 +165,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "queue/dispatch/fetch/respond) is recorded and "
                         "served as Chrome trace JSON on GET /debug/spans; "
                         "0 (default) disables tracing")
+    p.add_argument("--cost_telemetry", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="route worker compiles through the AOT path so "
+                        "GET /debug/compiles lists each bucket "
+                        "executable's flops/bytes/memory and the "
+                        "serve_mfu gauge is live (telemetry/costs.py); "
+                        "--no-cost_telemetry keeps the plain jit dispatch")
+    p.add_argument("--device_peak_tflops", type=float, default=None,
+                   help="peak TFLOP/s for the MFU denominator; default: "
+                        "auto table keyed by the local device kind")
     p.add_argument("--event_log", default=None,
-                   help="append structured JSONL run events (anomalies) "
-                        "to this file")
+                   help="append structured JSONL run events (compiles "
+                        "with cost summaries, anomalies) to this file")
     p.add_argument("--watchdog", action="store_true",
                    help="run the serving anomaly watchdog: queue "
                         "saturation and deadline-miss-rate detectors that "

@@ -135,6 +135,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(data-wait/dispatch/drain/checkpoint) is recorded "
                         "and served as Chrome trace JSON on GET "
                         "/debug/spans; 0 (default) disables tracing")
+    p.add_argument("--cost_telemetry", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="with the telemetry endpoint/event log on, route "
+                        "the train-step compile through the AOT path "
+                        "(jit().lower().compile()) so GET /debug/compiles "
+                        "lists the executable's flops/bytes/memory and "
+                        "the train_mfu / train_step_flops gauges are live "
+                        "(telemetry/costs.py); --no-cost_telemetry keeps "
+                        "the plain jit dispatch")
+    p.add_argument("--device_peak_tflops", type=float, default=None,
+                   help="peak TFLOP/s for the MFU denominator; default: "
+                        "auto table keyed by the local device kind "
+                        "(costs.DEVICE_PEAK_TFLOPS), MFU gauges stay 0 "
+                        "when unknown")
     p.add_argument("--stall_watchdog", action="store_true",
                    help="alarm (anomaly event + flight-recorder bundle) "
                         "when no step completes within 10x the rolling "
@@ -178,8 +192,9 @@ def main(argv=None):
     if args.metrics_port is not None and event_log_path is None:
         event_log_path = os.path.join(args.log_dir, "events.jsonl")
     if args.metrics_port is not None or event_log_path is not None:
-        from raft_stereo_tpu.telemetry import (EventLog, FlightRecorder,
-                                               SpanTracer,
+        from raft_stereo_tpu.telemetry import (CompileRegistry, EventLog,
+                                               FlightRecorder,
+                                               MetricsRegistry, SpanTracer,
                                                TelemetryHTTPServer,
                                                TrainTelemetry)
         if event_log_path is not None:
@@ -189,8 +204,15 @@ def main(argv=None):
             args.flight_recorder_dir
             or os.path.join(args.log_dir, "flightrecorder"),
             tracer=tracer)
-        telemetry = TrainTelemetry(events=events, tracer=tracer,
-                                   recorder=recorder)
+        registry = MetricsRegistry()
+        costs = None
+        if args.cost_telemetry:
+            costs = CompileRegistry(
+                registry=registry, events=events,
+                device_peak_tflops=args.device_peak_tflops)
+        telemetry = TrainTelemetry(registry=registry, events=events,
+                                   tracer=tracer, recorder=recorder,
+                                   costs=costs)
         recorder.registry = telemetry.registry
         if args.stall_watchdog:
             telemetry.enable_stall_watchdog()
@@ -201,11 +223,11 @@ def main(argv=None):
                 host=args.metrics_host, port=args.metrics_port,
                 trace=TraceCapture(
                     root=os.path.join(args.log_dir, "profiles")),
-                tracer=tracer, recorder=recorder).start()
+                tracer=tracer, recorder=recorder, costs=costs).start()
             log.info("training metrics endpoint on %s (GET /metrics, "
                      "GET /healthz, GET /debug/spans, GET /debug/stacks, "
-                     "GET /debug/flightrecorder, POST /debug/trace)",
-                     server.url)
+                     "GET /debug/flightrecorder, GET /debug/compiles, "
+                     "POST /debug/trace)", server.url)
 
     from raft_stereo_tpu.training.train_loop import train
     try:

@@ -43,7 +43,8 @@ class InferenceRunner:
                  shape_bucket: Optional[int] = None,
                  max_cached_shapes: int = 16,
                  corr_fp32_auto: bool = True,
-                 fetch_dtype: Optional[str] = None):
+                 fetch_dtype: Optional[str] = None,
+                 cost_registry=None, cost_site: str = "eval"):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -57,6 +58,13 @@ class InferenceRunner:
         the measured 32-iter drift on trained weights is the reason
         (BF16_DRIFT_r03.json).  Pass False to measure raw bf16 numerics
         (tools/bf16_drift.py does).
+        ``cost_registry`` (telemetry/costs.CompileRegistry | None): when
+        set, every per-shape compile routes through the AOT path
+        (``jit(...).lower(...).compile()``) so the executable's
+        cost/memory analysis and compile wall time are recorded, and the
+        cache's size/evictions feed its instruments; None (default) keeps
+        the exact plain-``jax.jit`` dispatch.  ``cost_site`` labels the
+        records ("eval" here, "serving" for service workers).
         ``fetch_dtype`` ("fp16" | "bf16" | None): cast the flow on DEVICE
         before the device->host fetch, halving the down-leg bytes — the
         dominant cost of the product path behind a bandwidth-bound tunnel
@@ -98,7 +106,24 @@ class InferenceRunner:
         self.fetch_dtype = {None: None, "fp16": jnp.float16,
                             "bf16": jnp.bfloat16}[fetch_dtype]
         self.model = RAFTStereo(self.effective_config)
+        self.cost_registry = cost_registry
+        self.cost_site = cost_site
         self._compiled: Dict[Tuple[int, int], any] = {}
+
+    def _cost_key(self, padded_hw: Tuple[int, int], batch: int) -> str:
+        """Stable label of one compile point in the cost registry —
+        what GET /debug/compiles lists and what the serving MFU path
+        looks up (``compiled_cost``)."""
+        return (f"{self.cost_site}.forward"
+                f"({padded_hw[0]}x{padded_hw[1]},b{batch})")
+
+    def compiled_cost(self, padded_hw: Tuple[int, int], batch: int = 1):
+        """The cost record for a compiled (padded shape, batch)
+        executable, or None (no registry / not compiled yet / analysis
+        degraded)."""
+        if self.cost_registry is None:
+            return None
+        return self.cost_registry.get(self._cost_key(padded_hw, batch))
 
     def _forward_for(self, padded_hw: Tuple[int, int], batch: int = 1):
         """One compiled program per (PADDED shape, batch) covering
@@ -115,7 +140,16 @@ class InferenceRunner:
         if key not in self._compiled:
             while len(self._compiled) >= self.max_cached_shapes:
                 # dicts iterate in insertion order -> drop the oldest
-                self._compiled.pop(next(iter(self._compiled)))
+                evicted = next(iter(self._compiled))
+                self._compiled.pop(evicted)
+                log.info(
+                    "compile cache full (max_cached_shapes=%d): evicting "
+                    "oldest executable for padded shape %s batch %d — "
+                    "its next use re-pays XLA compile time",
+                    self.max_cached_shapes, evicted[0], evicted[1])
+                if self.cost_registry is not None:
+                    self.cost_registry.note_runner_eviction(
+                        self._cost_key(*evicted), len(self._compiled))
             model, iters = self.model, self.iters
             fetch_dtype = self.fetch_dtype
 
@@ -129,7 +163,17 @@ class InferenceRunner:
                     flow_up = flow_up.astype(fetch_dtype)
                 return flow_up
 
+            if self.cost_registry is not None:
+                # AOT-instrumented dispatch: first call lowers + compiles
+                # through the registry (cost/memory analysis recorded),
+                # later calls hit the cached executable (telemetry/costs).
+                fwd = self.cost_registry.instrument(
+                    fwd, key=self._cost_key(padded_hw, batch),
+                    site=self.cost_site)
             self._compiled[key] = fwd
+            if self.cost_registry is not None:
+                self.cost_registry.note_runner_cache_size(
+                    len(self._compiled))
         else:  # LRU refresh
             self._compiled[key] = self._compiled.pop(key)
         return self._compiled[key]

@@ -102,7 +102,7 @@ class RAFTStereo(nn.Module):
 
     def __call__(self, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = 12, flow_init: Optional[jnp.ndarray] = None,
-                 test_mode: bool = False):
+                 test_mode: bool = False, unroll_gru: bool = False):
         """Estimate disparity for a rectified stereo pair.
 
         Args:
@@ -112,6 +112,14 @@ class RAFTStereo(nn.Module):
           test_mode: if True return ``(flow_low, flow_up)`` like the reference
             (core/raft_stereo.py:138-139); else the per-iteration list of
             full-resolution x-flow predictions, shape (iters, B, H, W).
+          unroll_gru: test-mode only — run the refinement loop as an
+            unrolled Python loop instead of ``lax.scan``.  Same math, same
+            weights; the compiled program inlines every iteration, which is
+            what ``tools/cost_report.py`` compiles because XLA's
+            ``cost_analysis`` counts a while-loop body ONCE regardless of
+            trip count, so only an unrolled executable carries honest
+            per-iteration flops.  Not for deployment: compile time grows
+            with ``iters``.
         """
         cfg = self.config
         dtype = self.compute_dtype
@@ -282,6 +290,13 @@ class RAFTStereo(nn.Module):
             # (reference: core/raft_stereo.py:120).
             disp = disp + delta_flow[..., 0].astype(jnp.float32)
             return net_list, disp, up_mask
+
+        if test_mode and unroll_gru:
+            mask = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+            for _ in range(iters):
+                net_list, disp, mask = gru_step(self, net_list, disp)
+            flow_up = self._upsample(disp, mask)
+            return disp, flow_up
 
         if test_mode:
             # No per-iteration outputs needed; the scan carries state (plus

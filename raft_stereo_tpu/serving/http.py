@@ -26,10 +26,13 @@ Endpoints:
   ``{"duration_ms": N}``; replies with the trace directory, 409 while a
   window is already open.
 * ``GET /debug/spans`` / ``GET /debug/stacks`` / ``GET|POST
-  /debug/flightrecorder`` — the same debug surface the training endpoint
-  serves (telemetry/http.py ``handle_debug_get``/``handle_debug_post``):
-  the request-path span ring as Chrome trace JSON, an all-thread stack
-  dump, and flight-recorder status / forced bundle dump.
+  /debug/flightrecorder`` / ``GET /debug/compiles`` — the same debug
+  surface the training endpoint serves (telemetry/http.py
+  ``handle_debug_get``/``handle_debug_post``): the request-path span ring
+  as Chrome trace JSON, an all-thread stack dump, flight-recorder status /
+  forced bundle dump, and the compile-cost registry's executable
+  inventory (flops / bytes accessed / memory analysis per bucket
+  executable; 404 unless ``ServeConfig.cost_telemetry``).
 
 ``ThreadingHTTPServer`` gives one Python thread per connection; the real
 concurrency limit is the service's bounded queue, which is the point —
@@ -139,7 +142,8 @@ def make_handler(service: StereoService,
                     "devices": len(service.devices)})
             elif handle_debug_get(path, url.query, service.tracer, recorder,
                                   service.metrics.registry,
-                                  self._reply, self._reply_json):
+                                  self._reply, self._reply_json,
+                                  costs=service.costs):
                 pass
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})

@@ -13,13 +13,20 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 from raft_stereo_tpu.telemetry.registry import (  # noqa: F401 — re-exports
     DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
 
 __all__ = ["DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "ServingMetrics"]
+           "MetricsRegistry", "ServingMetrics", "PADDING_WASTE_BUCKETS"]
+
+# Waste-fraction buckets for serve_padding_waste: fraction of dispatched
+# pixels that were padding (0 = every pixel real).  KITTI's /32 pad wastes
+# ~2.3% (375x1242 -> 384x1248); a stack-mode pow2 batch fill can waste up
+# to ~50%, hence the wide top end.
+PADDING_WASTE_BUCKETS = (0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
+                         0.3, 0.5, 0.75)
 
 
 class ServingMetrics:
@@ -75,12 +82,64 @@ class ServingMetrics:
         self.anomalies = r.counter(
             "serve_anomalies_total",
             "anomalies detected (queue saturation, deadline-miss rate)")
+        # Padding-waste accounting (telemetry/costs.py motivates it): the
+        # device runs padded shapes, so wasted pixels are wasted flops in
+        # exact proportion — the /32 spatial pad plus stack mode's pow2
+        # batch fill.  Complements serve_batch_occupancy (which only sees
+        # request counts, not pixel geometry).
+        self.padding_waste = r.histogram(
+            "serve_padding_waste",
+            "per-dispatch fraction of device pixels that were padding "
+            "(spatial /32 pad + stack-mode pow2 batch fill)",
+            buckets=PADDING_WASTE_BUCKETS)
+        self.dispatched_flops = r.counter(
+            "serve_dispatched_flops_total",
+            "model FLOPs dispatched to the device (compiled-executable "
+            "cost x dispatches; 0 without cost telemetry)")
+        self.achieved_flops_per_s = r.gauge(
+            "serve_achieved_flops_per_s",
+            "dispatched FLOP/s over the rolling MFU window (0 without "
+            "cost telemetry)")
+        self.mfu = r.gauge(
+            "serve_mfu",
+            "model FLOP utilization: achieved FLOP/s / device peak (0 "
+            "without cost telemetry or with an unknown peak)")
+        self._bucket_lock = threading.Lock()
+        self._bucket_px: Dict[str, Tuple[Counter, Counter]] = {}
         self.last_batch_unix = r.gauge(
             "serve_last_batch_unix_seconds",
             "wall-clock time the last micro-batch finished (0 until one "
             "does)")
         self._age_lock = threading.Lock()
         self._last_batch_mono: Optional[float] = None
+
+    def observe_padding(self, bucket: Tuple[int, int], real_pixels: int,
+                        dispatched_pixels: int) -> None:
+        """Record one dispatch's pixel accounting: ``real_pixels`` the sum
+        of un-padded image pixels in the batch, ``dispatched_pixels`` what
+        the device actually ran (frames x padded H x padded W, including
+        stack-mode batch fill).  Feeds the waste histogram and the
+        per-bucket real/pad counter family."""
+        if dispatched_pixels <= 0:
+            return
+        waste = max(0, dispatched_pixels - real_pixels)
+        self.padding_waste.observe(waste / dispatched_pixels)
+        label = f"{bucket[0]}x{bucket[1]}"
+        with self._bucket_lock:
+            pair = self._bucket_px.get(label)
+            if pair is None:
+                labels = {"bucket": label}
+                pair = (self.registry.counter(
+                            "serve_bucket_real_pixels_total",
+                            "un-padded image pixels dispatched, by padded-"
+                            "shape bucket", labels=labels),
+                        self.registry.counter(
+                            "serve_bucket_pad_pixels_total",
+                            "padding pixels dispatched (pure waste), by "
+                            "padded-shape bucket", labels=labels))
+                self._bucket_px[label] = pair
+        pair[0].inc(real_pixels)
+        pair[1].inc(waste)
 
     def note_batch_done(self) -> None:
         """Stamp micro-batch completion — the freshness signal behind

@@ -74,6 +74,15 @@ class ServeConfig:
     # Chrome trace JSON via GET /debug/spans).  0.0 (default) disables
     # tracing entirely — every span site takes the constant-time None exit.
     trace_sample_rate: float = 0.0
+    # Compile-cost telemetry (telemetry/costs.py): route every worker
+    # compile through the AOT path so GET /debug/compiles lists each
+    # bucket executable's flops/bytes/memory and the MFU gauges get their
+    # flops numerator.  False (default) keeps the workers' exact jax.jit
+    # dispatch — zero new code on the request path.
+    cost_telemetry: bool = False
+    # MFU denominator override (TFLOP/s); None = the auto table keyed by
+    # the local device kind (costs.DEVICE_PEAK_TFLOPS).
+    device_peak_tflops: Optional[float] = None
 
     def __post_init__(self):
         if self.batch_mode not in BATCH_MODES:
@@ -152,6 +161,21 @@ class StereoService:
         self.devices = list(devices)
         self.metrics = ServingMetrics(registry,
                                       max_batch=serve_cfg.max_batch)
+        # Compile-cost registry (telemetry/costs.py): one per service,
+        # shared by all workers — same bucket => same executable => one
+        # cost record.  None (default) leaves the runners' jit dispatch
+        # untouched.
+        self.costs = None
+        self._mfu = None
+        if serve_cfg.cost_telemetry:
+            from raft_stereo_tpu.telemetry.costs import (CompileRegistry,
+                                                         MfuMeter)
+            self.costs = CompileRegistry(
+                registry=self.metrics.registry,
+                device_peak_tflops=serve_cfg.device_peak_tflops)
+            self._mfu = MfuMeter(
+                self.metrics.mfu, self.costs.peak_flops,
+                achieved_gauge=self.metrics.achieved_flops_per_s)
         # Per-worker runner: variables live on that worker's device, and the
         # bounded per-(padded shape, batch) compile cache is per worker.
         self._runners: List[InferenceRunner] = []
@@ -160,7 +184,8 @@ class StereoService:
                 config, jax.device_put(variables, dev),
                 iters=serve_cfg.iters, shape_bucket=serve_cfg.shape_bucket,
                 max_cached_shapes=serve_cfg.max_cached_shapes,
-                fetch_dtype=serve_cfg.fetch_dtype))
+                fetch_dtype=serve_cfg.fetch_dtype,
+                cost_registry=self.costs, cost_site="serving"))
         self.config = self._runners[0].config
         self._divis = self._runners[0].divis_by
         # Handoff between the batcher's flush thread and the workers: small
@@ -307,6 +332,7 @@ class StereoService:
                 # N batch-1 dispatches through the one per-shape executable
                 # (bitwise-identical to solo InferenceRunner), pipelined by
                 # async dispatch, synced once below.
+                exec_batch, frames = 1, n
                 fwd = runner._forward_for(bucket, batch=1)
                 outs = [fwd(runner.variables,
                             jax.device_put(r.payload.left[None], device),
@@ -319,6 +345,7 @@ class StereoService:
                 # half-full flush wastes at most ~2x filler compute instead
                 # of always paying the full max_batch forward.
                 nb = 1 << (n - 1).bit_length()
+                exec_batch, frames = nb, nb
                 p1 = np.stack([r.payload.left for r in batch]
                               + [batch[-1].payload.left] * (nb - n))
                 p2 = np.stack([r.payload.right for r in batch]
@@ -354,6 +381,22 @@ class StereoService:
         self.metrics.batch_occupancy.observe(n)
         self.metrics.device_time.observe(device_s)
         self.metrics.fetch_time.observe(fetch_s)
+        # Padding-waste accounting: every dispatched pixel beyond the
+        # requests' real image pixels — the /32 spatial pad plus stack
+        # mode's pow2 batch fill — is pure waste at fixed GRU depth.
+        real_px = sum(r.payload.padder.ht * r.payload.padder.wd
+                      for r in batch)
+        self.metrics.observe_padding(bucket, real_px,
+                                     frames * bucket[0] * bucket[1])
+        # MFU numerator: the compiled executable's model flops times the
+        # dispatches this batch issued (chain: n batch-1 programs; stack:
+        # one batch-nb program).
+        if self._mfu is not None:
+            rec = runner.compiled_cost(bucket, batch=exec_batch)
+            if rec is not None and rec.flops:
+                flops = rec.flops * (n if exec_batch == 1 else 1)
+                self.metrics.dispatched_flops.inc(flops)
+                self._mfu.note(flops)
         self.metrics.note_batch_done()
         for r, fp, wait in zip(batch, flows_padded, waits):
             exemplar = r.trace.trace_id if r.trace is not None else None

@@ -3,6 +3,14 @@ one HTTP surface — and the request-path layer on top: span tracing, a
 flight recorder, and anomaly watchdogs.
 See docs/architecture.md §Observability."""
 
+from raft_stereo_tpu.telemetry.costs import (DEVICE_PEAK_TFLOPS,
+                                             CompileRecord, CompileRegistry,
+                                             MfuMeter, aot_cost_summary,
+                                             classify_bound,
+                                             executable_cost,
+                                             peak_bytes_per_s_for,
+                                             peak_flops_for,
+                                             ridge_flops_per_byte)
 from raft_stereo_tpu.telemetry.events import (SCHEMA_VERSION, EventLog,
                                               bench_record, replay,
                                               run_metadata, write_record)
@@ -25,6 +33,9 @@ from raft_stereo_tpu.telemetry.watchdog import (ANOMALY_VERSION, AnomalySink,
                                                 StepStallWatchdog)
 
 __all__ = [
+    "DEVICE_PEAK_TFLOPS", "CompileRecord", "CompileRegistry", "MfuMeter",
+    "aot_cost_summary", "classify_bound", "executable_cost",
+    "peak_bytes_per_s_for", "peak_flops_for", "ridge_flops_per_byte",
     "SCHEMA_VERSION", "EventLog", "bench_record", "replay", "run_metadata",
     "write_record", "FlightRecorder", "dump_all_stacks",
     "TelemetryHTTPServer", "DEFAULT_LATENCY_BUCKETS",

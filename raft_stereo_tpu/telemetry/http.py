@@ -20,6 +20,9 @@ serving/http.py, same response conventions):
   (where is the loop stuck RIGHT NOW).
 * ``GET /debug/flightrecorder`` — recorder status: ring occupancy, dump
   count, bundle paths.  ``POST`` to the same path forces a bundle dump.
+* ``GET /debug/compiles`` — the compile-cost registry's executable
+  inventory (telemetry/costs.py): per-executable flops, bytes accessed,
+  memory-analysis fields, compile wall time, arithmetic intensity.
 
 The /debug surface is shared verbatim with the serving endpoint
 (serving/http.py routes through ``handle_debug_get``/``handle_debug_post``
@@ -88,9 +91,19 @@ def handle_debug_get(path: str, query: str,
                      recorder: Optional[FlightRecorder],
                      registry: Optional[MetricsRegistry],
                      reply: Callable[[int, bytes, str], None],
-                     reply_json: Callable[[int, object], None]) -> bool:
+                     reply_json: Callable[[int, object], None],
+                     costs=None) -> bool:
     """The shared GET /debug/* surface (training AND serving endpoints).
-    Returns True when the path was one of ours."""
+    Returns True when the path was one of ours.  ``costs`` is the optional
+    ``telemetry.costs.CompileRegistry`` behind ``GET /debug/compiles``."""
+    if path == "/debug/compiles":
+        if costs is None:
+            reply_json(404, {"error": "compile-cost registry not wired on "
+                                      "this endpoint (enable cost "
+                                      "telemetry)"})
+            return True
+        reply_json(200, costs.to_json())
+        return True
     if path == "/debug/spans":
         if tracer is None:
             reply_json(404, {"error": "span tracing not wired on this "
@@ -142,7 +155,8 @@ def make_telemetry_handler(registry: MetricsRegistry,
                            healthz_fn: Callable[[], Dict[str, object]],
                            trace: Optional[TraceCapture] = None,
                            tracer: Optional[SpanTracer] = None,
-                           recorder: Optional[FlightRecorder] = None):
+                           recorder: Optional[FlightRecorder] = None,
+                           costs=None):
     """Handler class closed over the instruments (the serving/http.py
     pattern: BaseHTTPRequestHandler is instantiated per request, so state
     rides the closure)."""
@@ -172,7 +186,8 @@ def make_telemetry_handler(registry: MetricsRegistry,
             elif path == "/healthz":
                 self._reply_json(200, healthz_fn())
             elif handle_debug_get(path, query, tracer, recorder, registry,
-                                  self._reply, self._reply_json):
+                                  self._reply, self._reply_json,
+                                  costs=costs):
                 pass
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
@@ -199,15 +214,18 @@ class TelemetryHTTPServer:
                  host: str = "127.0.0.1", port: int = 9100,
                  trace: Optional[TraceCapture] = None,
                  tracer: Optional[SpanTracer] = None,
-                 recorder: Optional[FlightRecorder] = None):
+                 recorder: Optional[FlightRecorder] = None,
+                 costs=None):
         self.registry = registry
         self.trace = trace if trace is not None else TraceCapture()
         self.tracer = tracer
         self.recorder = recorder
+        self.costs = costs
         self.server = ThreadingHTTPServer(
             (host, port),
             make_telemetry_handler(registry, healthz_fn, self.trace,
-                                   tracer=tracer, recorder=recorder))
+                                   tracer=tracer, recorder=recorder,
+                                   costs=costs))
         self._thread = None
 
     @property

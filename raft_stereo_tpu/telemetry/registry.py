@@ -234,17 +234,29 @@ class Histogram:
 
 
 class MetricsRegistry:
-    """Named instruments + the text exposition the HTTP endpoint serves."""
+    """Named instruments + the text exposition the HTTP endpoint serves.
+
+    Instruments are keyed by ``(name, labels)``: several instruments may
+    share a name with distinct constant labels (a *family* — the
+    per-bucket padding-waste counters use this), and ``render_text``
+    groups a family under one HELP/TYPE header as the exposition format
+    requires.  Re-registering the exact same (name, labels) still
+    raises — that is a real double-registration bug."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._instruments: Dict[str, object] = {}
+        self._instruments: Dict[tuple, object] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
 
     def _register(self, inst):
         with self._lock:
-            if inst.name in self._instruments:
+            key = self._key(inst.name, inst.labels)
+            if key in self._instruments:
                 raise ValueError(f"metric {inst.name!r} already registered")
-            self._instruments[inst.name] = inst
+            self._instruments[key] = inst
         return inst
 
     def counter(self, name: str, help: str = "",
@@ -262,20 +274,39 @@ class MetricsRegistry:
         return self._register(Histogram(name, help, buckets, reservoir,
                                         labels=labels))
 
-    def get(self, name: str):
+    def get(self, name: str,
+            labels: Optional[Dict[str, str]] = None):
+        """Instrument by name (and labels, for family members).  With no
+        ``labels``, an unlabeled instrument of that name wins; otherwise
+        the family's first-registered member is returned."""
         with self._lock:
-            return self._instruments.get(name)
+            inst = self._instruments.get(self._key(name, labels))
+            if inst is not None or labels is not None:
+                return inst
+            for (n, _), i in self._instruments.items():
+                if n == name:
+                    return i
+            return None
 
     def items(self):
         """Snapshot of (name, instrument) pairs (the debug surfaces walk
-        this for exemplars)."""
+        this for exemplars); family members repeat the name."""
         with self._lock:
-            return list(self._instruments.items())
+            return [(name, inst)
+                    for (name, _), inst in self._instruments.items()]
 
     def render_text(self) -> str:
         with self._lock:
             insts = list(self._instruments.values())
-        lines: List[str] = []
+        # Group same-name instruments (label families) so each name gets
+        # exactly one HELP/TYPE header followed by all its sample lines —
+        # strict text-format parsers reject interleaved/duplicate headers.
+        by_name: Dict[str, List[object]] = {}
         for inst in insts:
-            lines.extend(inst.render())
+            by_name.setdefault(inst.name, []).append(inst)
+        lines: List[str] = []
+        for name, group in by_name.items():
+            lines.extend(group[0].render())
+            for inst in group[1:]:
+                lines.extend(inst.render()[2:])  # drop repeat HELP/TYPE
         return "\n".join(lines) + "\n"

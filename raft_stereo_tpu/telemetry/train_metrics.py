@@ -47,6 +47,10 @@ from raft_stereo_tpu.telemetry.watchdog import AnomalySink, NonFiniteSentinel
 
 log = logging.getLogger(__name__)
 
+# The cost-registry key the train loop instruments its jitted step under
+# (training/train_loop.py) and the drain's MFU computation looks up.
+TRAIN_STEP_COST_KEY = "train.step"
+
 # Pixel-scale buckets for GRU disparity-delta magnitudes: sub-milli-px
 # (converged) up to tens of px (early iterations at SceneFlow disparities).
 GRU_DELTA_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2,
@@ -123,10 +127,15 @@ class TrainTelemetry:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  events: Optional[EventLog] = None,
                  tracer: Optional[SpanTracer] = None,
-                 recorder=None, stall_watchdog=None):
+                 recorder=None, stall_watchdog=None, costs=None):
         r = registry or MetricsRegistry()
         self.registry = r
         self.events = events
+        # Compile-cost registry (telemetry/costs.py).  When set, the train
+        # loop routes its step compile through the AOT path, and the drain
+        # turns the recorded executable flops into train_step_flops /
+        # train_mfu below.  None (default) = the plain jit step dispatch.
+        self.costs = costs
         # Span tracer (telemetry/spans.py): default sampling 0.0 — every
         # span site below takes the constant-time None exit, preserving the
         # zero-extra-work guarantee of the PR 3 instrumentation.
@@ -183,6 +192,18 @@ class TrainTelemetry:
         self.checkpoint_time = r.histogram(
             "train_checkpoint_seconds", "checkpoint fetch + write",
             buckets=DEFAULT_LATENCY_BUCKETS)
+        self.step_flops = r.gauge(
+            "train_step_flops",
+            "compiled train-step executable FLOPs (cost_analysis; 0 "
+            "without cost telemetry or where the backend reports none)")
+        self.achieved_flops_per_s = r.gauge(
+            "train_achieved_flops_per_s",
+            "step FLOPs x steps / wall time over the last drain window "
+            "(0 without cost telemetry)")
+        self.mfu = r.gauge(
+            "train_mfu",
+            "model FLOP utilization: achieved FLOP/s / device peak (0 "
+            "without cost telemetry or with an unknown peak)")
         self.gru_delta = r.histogram(
             "train_gru_delta_px",
             "per-iteration |disparity update| means "
@@ -301,8 +322,22 @@ class TrainTelemetry:
             self._last_drain_mono = now
             self._steps_at_last_drain = step
             batch = self._batch_size
+        step_flops = 0.0
+        if self.costs is not None:
+            rec = self.costs.get(TRAIN_STEP_COST_KEY)
+            if rec is not None and rec.flops:
+                step_flops = rec.flops
+                self.step_flops.set(step_flops)
         if elapsed > 0 and n_steps > 0:
             self.images_per_s.set(n_steps * max(1, batch) / elapsed)
+            if step_flops:
+                # MFU over the drain window: the executable's model flops
+                # are exact per step (fixed shapes), the wall clock is the
+                # window the throughput gauge already uses.
+                achieved = step_flops * n_steps / elapsed
+                self.achieved_flops_per_s.set(achieved)
+                if self.costs.peak_flops:
+                    self.mfu.set(achieved / self.costs.peak_flops)
         self.host_rss.set(host_rss_bytes())
         try:
             from raft_stereo_tpu.profiling import device_memory_stats
@@ -319,7 +354,9 @@ class TrainTelemetry:
                 data_wait_ms_p50=self.data_wait.percentile(50) * 1e3,
                 step_ms_p50=self.step_time.percentile(50) * 1e3,
                 host_rss_bytes=int(self.host_rss.value),
-                device_bytes_in_use=int(self.device_bytes.value))
+                device_bytes_in_use=int(self.device_bytes.value),
+                step_flops=step_flops,
+                mfu=self.mfu.value)
 
     def observe_gru_deltas(self, deltas: Iterable[float]) -> None:
         """Per-iteration mean |disparity update| magnitudes (px), already on

@@ -295,6 +295,16 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             run_validation = validate_fn
 
     step_fn = make_train_step(train_cfg, mesh=mesh)
+    if telemetry is not None and getattr(telemetry, "costs", None) is not None:
+        # AOT-instrumented step dispatch (telemetry/costs.py): the first
+        # batch lowers + compiles through the cost registry, recording the
+        # executable's flops/bytes/memory — the numerator of train_mfu and
+        # the step_flops field of every step_stats event.  Without a cost
+        # registry the jitted step is called exactly as before.
+        from raft_stereo_tpu.telemetry.train_metrics import (
+            TRAIN_STEP_COST_KEY)
+        step_fn = telemetry.costs.instrument(
+            step_fn, key=TRAIN_STEP_COST_KEY, site="train")
     _, schedule = make_optimizer(train_cfg)
 
     os.makedirs(checkpoint_dir, exist_ok=True)

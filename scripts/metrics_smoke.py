@@ -50,7 +50,8 @@ def main() -> int:
 
     from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
     from raft_stereo_tpu.data.loader import StereoLoader
-    from raft_stereo_tpu.telemetry import (EventLog, FlightRecorder,
+    from raft_stereo_tpu.telemetry import (CompileRegistry, EventLog,
+                                           FlightRecorder, MetricsRegistry,
                                            SpanTracer, TelemetryHTTPServer,
                                            TrainTelemetry, replay)
     from raft_stereo_tpu.training.train_loop import train
@@ -62,12 +63,15 @@ def main() -> int:
     tracer = SpanTracer(1.0)              # smoke samples every step
     recorder = FlightRecorder(os.path.join(tmp, "flightrecorder"),
                               tracer=tracer, min_interval_s=0.0)
-    telemetry = TrainTelemetry(events=events, tracer=tracer,
-                               recorder=recorder)
+    registry = MetricsRegistry()
+    costs = CompileRegistry(registry=registry, events=events)
+    telemetry = TrainTelemetry(registry=registry, events=events,
+                               tracer=tracer, recorder=recorder,
+                               costs=costs)
     recorder.registry = telemetry.registry
     server = TelemetryHTTPServer(telemetry.registry, telemetry.healthz,
                                  port=0, tracer=tracer,
-                                 recorder=recorder).start()
+                                 recorder=recorder, costs=costs).start()
     print(f"metrics endpoint: {server.url} (artifacts: {tmp})")
 
     # InstanceNorm's optimization_barrier has no CPU differentiation rule
@@ -128,9 +132,27 @@ def main() -> int:
         assert fr["dumps"] == 0, fr  # healthy run: nothing triggered
         assert fr["spans"]["ring_size"] >= NUM_STEPS, fr
 
+        # Compile-cost registry end to end: the AOT-instrumented train
+        # step is in the inventory with cost + memory analysis, and the
+        # drain turned its flops into a live gauge.
+        compiles = json.load(urllib.request.urlopen(
+            server.url + "/debug/compiles", timeout=10))
+        assert compiles["count"] >= 1, compiles
+        execs = {e["key"]: e for e in compiles["executables"]}
+        assert "train.step" in execs, sorted(execs)
+        step_exec = execs["train.step"]
+        assert step_exec["flops"] and step_exec["flops"] > 0, step_exec
+        assert step_exec["memory"] and \
+            step_exec["memory"]["argument_size_in_bytes"] > 0, step_exec
+        flops_line = [l for l in metrics.splitlines()
+                      if l.startswith("train_step_flops ")]
+        assert flops_line and float(flops_line[0].split()[1]) > 0, \
+            f"train_step_flops missing/zero: {flops_line}"
+
         kinds = [e["event"] for e in replay(events.path)]
         assert kinds[0] == "run_start" and kinds[-1] == "run_end", kinds
         assert "step_stats" in kinds and "checkpoint" in kinds, kinds
+        assert "compile" in kinds, kinds  # the AOT step compile evented
     except BaseException:
         # Leave the evidence where ci.yml uploads it from.
         try:

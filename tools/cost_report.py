@@ -1,0 +1,277 @@
+"""Per-phase compiler-cost report: what the model REQUIRES, phase by phase.
+
+bench.py's ``realtime_phase_split`` line measures where the wall-clock
+goes; this tool produces the model-side complement — where the FLOPs and
+bytes go, straight from XLA's ``cost_analysis`` on AOT-compiled
+executables (telemetry/costs.py), split into the same phases the model
+annotates (models/raft_stereo.py: fnet / cnet / corr_pyramid / gru_iter /
+upsample).  Dividing a phase's flops by its measured seconds gives
+per-phase achieved FLOP/s, hence per-phase MFU against the device peak.
+
+Method — exact where it matters, residual-accounted everywhere else:
+
+* ``gru_iter``: difference the whole-model executable at ``iters`` vs
+  ``iters=1`` — cost_analysis is deterministic per program, so the
+  per-iteration cost is exact, with the corr LOOKUPS included (that is
+  what runs inside the ``gru_iter`` annotation).
+* ``fnet`` / ``cnet`` / ``corr_pyramid`` / ``upsample``: compile each
+  phase's computation standalone (same shapes/dtypes the full model
+  traces).
+* ``other``: the residual of the fixed (non-iterated) part — image
+  normalization, context-bias convs, tanh/relu heads — so the per-phase
+  flop totals sum to the whole-model executable's flops EXACTLY (the
+  report's ``sum_check`` asserts it to float tolerance).
+
+Each phase gets a roofline classification: arithmetic intensity
+(flops / bytes accessed) against the device ridge point
+(peak FLOP/s / peak bytes/s — auto tables in telemetry/costs.py,
+``--device_peak_tflops`` / ``--device_peak_gbps`` to override, a
+documented TPU-class default when the device is unknown, e.g. CPU CI).
+
+    python tools/cost_report.py                    # realtime @ KITTI res
+    python tools/cost_report.py --config default --iters 32
+    python tools/cost_report.py --height 64 --width 96 --iters 2  # CI
+
+Writes ``COST_REPORT_<tag>.json`` (shared versioned bench header,
+telemetry/events.py) and prints a one-line JSON summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_TAG = "r10"
+_COST_KEYS = ("flops", "bytes_accessed")
+
+
+def _phase(cost: Dict, scale: float = 1.0) -> Dict[str, Optional[float]]:
+    """Project an aot_cost_summary onto the report's (flops, bytes) pair."""
+    return {k: (cost.get(k) * scale if cost.get(k) is not None else None)
+            for k in _COST_KEYS}
+
+
+def _sub(a: Dict, *subtrahends: Dict) -> Dict[str, Optional[float]]:
+    out = {}
+    for k in _COST_KEYS:
+        v = a.get(k)
+        for s in subtrahends:
+            v = (v - s[k]) if (v is not None and s.get(k) is not None) else None
+        out[k] = v
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="realtime",
+                   choices=["realtime", "default", "tiny"],
+                   help="realtime/default: the published architectures; "
+                        "tiny: the hermetic test model (CI/CPU runs)")
+    p.add_argument("--height", type=int, default=384)
+    p.add_argument("--width", type=int, default=1248)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--iters", type=int, default=7,
+                   help="GRU iterations of the reported executable "
+                        "(realtime inference runs 7, eval 32)")
+    p.add_argument("--tag", default=DEFAULT_TAG,
+                   help="suffix of the default output file name")
+    p.add_argument("--out", default=None,
+                   help="output path; default COST_REPORT_<tag>.json")
+    p.add_argument("--device_peak_tflops", type=float, default=None)
+    p.add_argument("--device_peak_gbps", type=float, default=None)
+    return p
+
+
+def model_config(name: str):
+    from raft_stereo_tpu.config import RaftStereoConfig
+    if name == "realtime":
+        return RaftStereoConfig.realtime()
+    if name == "tiny":
+        return RaftStereoConfig(n_gru_layers=1, hidden_dims=(32,),
+                                fnet_dim=64, fnet_norm="none",
+                                corr_backend="reg")
+    return RaftStereoConfig.default()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.models.corr import (build_corr_pyramid,
+                                             build_corr_volume, pool_axis)
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.ops.upsample import convex_upsample
+    from raft_stereo_tpu.telemetry.costs import (aot_cost_summary,
+                                                 classify_bound,
+                                                 peak_bytes_per_s_for,
+                                                 peak_flops_for,
+                                                 ridge_flops_per_byte)
+    from raft_stereo_tpu.telemetry.events import write_record
+
+    cfg = model_config(args.config)
+    if args.height % 32 or args.width % 32:
+        raise SystemExit(f"--height/--width must be /32-padded shapes, got "
+                         f"{args.height}x{args.width}")
+    if args.iters < 2:
+        raise SystemExit("--iters must be >= 2 (the gru_iter phase is "
+                         "isolated by differencing iters vs iters=1)")
+    model = RAFTStereo(cfg)
+    b, h, w = args.batch, args.height, args.width
+    dtype = model.compute_dtype
+    f = cfg.downsample_factor
+    hf, wf = h // f, w // f
+
+    img_small = jnp.zeros((1, 64, 96, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img_small, img_small,
+                                             iters=1, test_mode=True)
+                        )(jax.random.PRNGKey(0))
+    img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+
+    # --- whole-model executables at two GRU depths (the exact part) -------
+    # unroll_gru=True: XLA's cost_analysis counts a while-loop (lax.scan)
+    # body ONCE regardless of trip count, so the deployed scan executable
+    # reports near-identical flops at any depth.  The unrolled twin runs
+    # the same math with every iteration inline — its cost scales with
+    # ``iters`` honestly and differencing two depths isolates one
+    # iteration exactly.
+    def forward(iters, unroll=True):
+        return jax.jit(lambda v, a, c: model.apply(
+            v, a, c, iters=iters, test_mode=True, unroll_gru=unroll)[1])
+
+    full = aot_cost_summary(forward(args.iters), variables, img, img)
+    full_1 = aot_cost_summary(forward(1), variables, img, img)
+    # The deployed (scan) executable, for the record — flops undercounted
+    # by the loop-body-once convention, memory analysis honest.
+    deployed = aot_cost_summary(forward(args.iters, unroll=False),
+                                variables, img, img)
+    per_iter = {k: ((full[k] - full_1[k]) / (args.iters - 1)
+                    if full.get(k) is not None and full_1.get(k) is not None
+                    else None) for k in _COST_KEYS}
+    gru_total = _phase(per_iter, float(args.iters))
+    fixed = _sub(_phase(full), gru_total)
+
+    # --- standalone phase compiles (same shapes the full trace sees) ------
+    norm_img = jax.ShapeDtypeStruct(
+        ((2 * b,) if cfg.shared_backbone else (b,)) + (h, w, 3), dtype)
+    cnet_fn = jax.jit(lambda v, x: model.apply(
+        v, x, method=lambda m, xx: m.cnet(xx)))
+    cnet = aot_cost_summary(cnet_fn, variables, norm_img)
+
+    if cfg.shared_backbone:
+        # fnet = conv2_res + conv2_out over the shared trunk feature v.
+        _, v_shape = jax.eval_shape(cnet_fn, variables, norm_img)
+        fnet = aot_cost_summary(
+            jax.jit(lambda vr, x: model.apply(
+                vr, x, method=lambda m, xx: m.conv2_out(m.conv2_res(xx)))),
+            variables, jax.ShapeDtypeStruct(v_shape.shape, v_shape.dtype))
+    else:
+        fnet = aot_cost_summary(
+            jax.jit(lambda vr, x: model.apply(
+                vr, x, method=lambda m, xx: m.fnet(xx))),
+            variables,
+            jax.ShapeDtypeStruct((2 * b, h, w, 3), dtype))
+
+    fmap = jax.ShapeDtypeStruct((b, hf, wf, cfg.fnet_dim), dtype)
+    corr_f32 = cfg.corr_fp32 or cfg.corr_backend in ("reg", "alt")
+    if cfg.corr_backend == "alt":
+        # alt builds no volume — the annotated build is the pooled right-
+        # feature pyramid; lookups run inside gru_iter (differenced above).
+        def corr_build(f1, f2):
+            f2 = f2.astype(jnp.float32) if corr_f32 else f2
+            py = [f2]
+            for _ in range(cfg.corr_levels - 1):
+                py.append(pool_axis(py[-1], axis=2))
+            return tuple(py)
+    else:
+        def corr_build(f1, f2):
+            compute = jnp.float32 if corr_f32 else f1.dtype
+            vol = build_corr_volume(f1.astype(jnp.float32),
+                                    f2.astype(jnp.float32)).astype(compute)
+            return tuple(build_corr_pyramid(vol, cfg.corr_levels))
+    corr = aot_cost_summary(jax.jit(corr_build), fmap, fmap)
+
+    upsample = aot_cost_summary(
+        jax.jit(lambda d, m: convex_upsample(d, m, f)[..., 0]),
+        jax.ShapeDtypeStruct((b, hf, wf, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, hf, wf, cfg.mask_channels), jnp.float32))
+
+    phases = {
+        "fnet": _phase(fnet),
+        "cnet": _phase(cnet),
+        "corr_pyramid": _phase(corr),
+        "gru_iter": dict(gru_total, per_iteration=per_iter,
+                         iterations=args.iters),
+        "upsample": _phase(upsample),
+    }
+    phases["other"] = _sub(fixed, *(
+        {k: p[k] for k in _COST_KEYS}
+        for name, p in phases.items() if name != "gru_iter"))
+
+    # --- roofline classification ------------------------------------------
+    peak_f = peak_flops_for(override_tflops=args.device_peak_tflops)
+    peak_b = peak_bytes_per_s_for(override_gbps=args.device_peak_gbps)
+    ridge, ridge_source = ridge_flops_per_byte(peak_f, peak_b)
+    for p in phases.values():
+        fl, ba = p.get("flops"), p.get("bytes_accessed")
+        p["arithmetic_intensity"] = fl / ba if fl and ba else None
+        p["bound"] = classify_bound(fl, ba, ridge)
+
+    phase_flops = sum(p["flops"] or 0.0 for p in phases.values())
+    model_flops = full.get("flops")
+    sum_check = {
+        "phase_flops_total": phase_flops,
+        "model_flops": model_flops,
+        "rel_err": (abs(phase_flops - model_flops) / model_flops
+                    if model_flops else None),
+    }
+
+    rec = {
+        "metric": "cost_report",
+        "config": args.config,
+        "shape": [b, h, w],
+        "iters": args.iters,
+        "model_config": cfg.to_dict(),
+        "whole_model": full,          # unrolled: flops/bytes/memory/compile_s
+        "whole_model_iters1": full_1,
+        "deployed_scan_executable": dict(
+            deployed,
+            note="lax.scan while-loop body counted once by XLA "
+                 "cost_analysis — use whole_model (unrolled) flops as "
+                 "the denominator"),
+        "phases": phases,
+        "sum_check": sum_check,
+        "roofline": {
+            "peak_flops_per_s": peak_f,
+            "peak_bytes_per_s": peak_b,
+            "ridge_flops_per_byte": ridge,
+            "ridge_source": ridge_source,
+        },
+        "degraded": bool(full.get("degraded", True)),
+        "notes": "phase seconds from bench.py realtime_phase_split / "
+                 "device traces; phase MFU = phase flops / (seconds x "
+                 "peak_flops_per_s)",
+    }
+    out = args.out or f"COST_REPORT_{args.tag}.json"
+    write_record(out, rec, indent=2)
+    print(json.dumps({
+        "metric": "cost_report",
+        "out": out,
+        "model_gflops": (round(model_flops / 1e9, 3)
+                         if model_flops else None),
+        "gru_share": (round((phases["gru_iter"]["flops"] or 0)
+                            / model_flops, 3) if model_flops else None),
+        "bounds": {k: v["bound"] for k, v in phases.items()},
+        "sum_rel_err": sum_check["rel_err"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
