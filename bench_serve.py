@@ -1,21 +1,27 @@
-"""Serving-path throughput/latency: the micro-batching service under load.
+"""Serving-engine throughput/latency: batch-N buckets under load.
 
-bench_product.py measures the per-image and hand-batched product paths with
-ONE caller; this bench drives the serving subsystem (raft_stereo_tpu/serving)
-the way traffic actually arrives — an open-loop generator offering requests
-at a fixed rate, independent of service progress — across several offered
-loads and batch settings, against the single-caller solo baseline measured
-in the same run.  Open-loop matters: a closed loop (submit, wait, repeat)
-self-throttles exactly when the service is slow and hides queueing collapse;
-open-loop exposes it, and the bounded queue's typed shedding is part of the
-result, not an error.
+Round 6's bench (BENCH_SERVE_r06.json) was damning for the old
+chain/stack design: best throughput 1.015x solo inference.  This round
+benches the unified serving engine (raft_stereo_tpu/serving/engine.py) two
+ways:
 
-Per setting: completed/s, p50/p95/p99 end-to-end latency, the queue-wait
-share, mean batch occupancy, and shed counts — all read from the service's
-own metrics layer (serving/metrics.py), which is the point: the
-observability surface is what gets benchmarked.
+* **Occupancy sweep** — staged bursts at exactly each compiled batch size
+  (1/2/4/8): requests per dispatch, per-dispatch wall time, and per-bucket
+  MFU computed from the cost registry's executable flops (the batch-N
+  amortization curve, measured not assumed).
+* **Open-loop offered load** — a generator offering Poisson traffic at a
+  fixed rate, independent of service progress, against the single-caller
+  solo baseline measured in the same run.  Open-loop matters: a closed
+  loop self-throttles exactly when the service is slow and hides queueing
+  collapse; with continuous batching the queue depth sets the dispatch
+  occupancy, so this is also what exercises the scheduler.
 
-Prints one JSON line (bench.py contract) and writes BENCH_SERVE_r06.json.
+The record compares against BENCH_SERVE_r06.json's chain mode and WARNS on
+regression: engine throughput must beat the old best, and requests-per-
+dispatch at occupancy >= 2 must beat chain mode's serial 1-per-dispatch
+(acceptance: dispatch count < completed request count).
+
+Prints one JSON line (bench.py contract) and writes BENCH_SERVE_r11.json.
 On a CPU fallback the model/geometry shrink so the bench completes in
 minutes; on an accelerator it runs the realtime config at KITTI resolution.
 """
@@ -32,7 +38,8 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_REPO, "tests"))
 
-OUT = "BENCH_SERVE_r06.json"
+OUT = "BENCH_SERVE_r11.json"
+BASELINE = "BENCH_SERVE_r06.json"
 
 
 def build_model(on_cpu: bool):
@@ -42,10 +49,13 @@ def build_model(on_cpu: bool):
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 
-    if on_cpu:  # CPU fallback: keep the bench minutes-scale
+    if on_cpu:  # CPU fallback: keep the bench minutes-scale.  The raw
+        # shape is deliberately off-grid (pads to the same 128x192 program
+        # r06 benched) so the padding-waste accounting reports real
+        # numbers, like KITTI's 375x1242 -> 384x1248 does on device.
         cfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
                                corr_backend="reg")
-        hw, iters = (128, 192), 2
+        hw, iters = (125, 190), 2
     else:
         cfg = RaftStereoConfig.realtime()
         hw, iters = (375, 1242), 7   # bench_product.py's realtime protocol
@@ -57,30 +67,82 @@ def build_model(on_cpu: bool):
     return cfg, variables, hw, iters
 
 
+def _pairs(hw, n, rng):
+    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+             for _ in range(n)]
+    return lefts, [np.roll(l, -5, axis=1) for l in lefts]
+
+
+def occupancy_sweep(cfg, variables, hw, iters, rng,
+                    sizes=(1, 2, 4, 8), rounds=5) -> list:
+    """Per-batch-size amortization: ``rounds`` staged bursts of exactly
+    ``k`` requests each (the queue's pause/resume hook pins occupancy), so
+    every dispatch runs the batch-``k`` bucket executable.  MFU per bucket
+    comes straight from the cost registry's flops for that executable."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    lefts, rights = _pairs(hw, 4, rng)
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=max(sizes), batch_sizes=tuple(sizes), max_queue=64,
+        iters=iters, cost_telemetry=True))
+    out = []
+    try:
+        svc.prewarm(hw)   # compile + warm the whole bucket ladder
+        bucket = svc.bucket_for(hw + (3,))
+        for k in sizes:
+            d0 = svc.metrics.dispatches_at(k)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                svc.queue.pause()
+                futs = [svc.submit(lefts[i % 4], rights[i % 4])
+                        for i in range(k)]
+                svc.queue.resume()
+                for f in futs:
+                    f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            dispatches = svc.metrics.dispatches_at(k) - d0
+            rec = svc.compiled_cost(bucket, batch=k)
+            flops = rec.flops if rec is not None else None
+            achieved = (flops * dispatches / wall if flops else None)
+            row = {
+                "batch": k,
+                "requests": rounds * k,
+                "dispatches": dispatches,
+                "req_per_dispatch": round(rounds * k / max(1, dispatches),
+                                          2),
+                "wall_s": round(wall, 3),
+                "req_per_s": round(rounds * k / wall, 3),
+                "dispatch_ms_mean": round(wall / max(1, dispatches) * 1e3,
+                                          1),
+                "executable_flops": flops,
+                "achieved_flops_per_s": (round(achieved)
+                                         if achieved else None),
+                "serve_mfu": round(svc.metrics.mfu.value, 6),
+                "padding_waste_mean": round(
+                    svc.metrics.padding_waste.mean(), 4),
+                "bucket_pixels": svc.metrics.bucket_pixels(),
+            }
+            out.append(row)
+            print(json.dumps({"occupancy_sweep": row}), flush=True)
+    finally:
+        svc.close()
+    return out
+
+
 def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
-                     n_requests: int, max_batch: int, batch_mode: str,
+                     n_requests: int, max_batch: int,
                      max_queue: int, rng: np.random.Generator) -> dict:
     """One open-loop run: submit at ``rate_hz`` (exponential inter-arrival
     times — Poisson traffic), wait for completion, report from metrics."""
     from raft_stereo_tpu.serving import Overloaded, ServeConfig, StereoService
 
-    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8)
-             for _ in range(4)]
-    rights = [np.roll(l, -5, axis=1) for l in lefts]
+    lefts, rights = _pairs(hw, 4, rng)
     svc = StereoService(cfg, variables, ServeConfig(
-        max_batch=max_batch, max_wait_ms=8.0, max_queue=max_queue,
-        batch_mode=batch_mode, iters=iters))
+        max_batch=max_batch, max_queue=max_queue, iters=iters,
+        cost_telemetry=True))
     try:
-        # Compile + warm: solo first (batch-1 executable), then concurrent
-        # bursts so stack mode's power-of-two batch executables compile
-        # before the measured window, as the solo warmup absorbs XLA
-        # compilation in the FPS protocol (profiling.FpsProtocol).
-        svc.infer(lefts[0], rights[0], timeout=600)
-        for _ in range(3):
-            warm = [svc.submit(lefts[i % 4], rights[i % 4])
-                    for i in range(max_batch)]
-            for f in warm:
-                f.result(timeout=600)
+        svc.prewarm(hw)    # all bucket sizes compiled before the window
+        d0 = svc.metrics.batches.value
         gaps = rng.exponential(1.0 / rate_hz, n_requests)
         futures, shed = [], 0
         t0 = time.perf_counter()
@@ -95,10 +157,7 @@ def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
                 shed += 1
         results = [f.result(timeout=600) for f in futures]
         wall = time.perf_counter() - t0
-        # Per-run stats come from the ServeResults — each carries the
-        # metrics layer's stage decomposition (queue wait / device / fetch,
-        # micro-batch occupancy) for exactly the measured window, while the
-        # service-lifetime histograms also include the warmup above.
+        dispatches = svc.metrics.batches.value - d0
         total = np.array([r.total_s for r in results])
         qwait = np.array([r.queue_wait_s for r in results])
         occ = np.array([r.batch_size for r in results])
@@ -106,10 +165,11 @@ def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
         return {
             "offered_hz": round(rate_hz, 2),
             "max_batch": max_batch,
-            "batch_mode": batch_mode,
             "offered": n_requests,
             "completed": len(results),
             "shed_queue_full": shed,
+            "dispatches": dispatches,
+            "req_per_dispatch": round(len(results) / max(1, dispatches), 2),
             "throughput_hz": round(len(results) / wall, 2),
             "latency_ms": {f"p{q}": pct(total, q) for q in (50, 95, 99)},
             "queue_wait_ms": {
@@ -120,10 +180,45 @@ def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
             "fetch_ms_mean": round(float(np.mean(
                 [r.fetch_s for r in results])) * 1e3, 1),
             "batch_occupancy_mean": round(float(occ.mean()), 2),
-            "batches": svc.metrics.batches.value,
+            "serve_mfu": round(svc.metrics.mfu.value, 6),
+            "padding_waste_mean": round(svc.metrics.padding_waste.mean(),
+                                        4),
+            "bucket_pixels": svc.metrics.bucket_pixels(),
         }
     finally:
         svc.close()
+
+
+def compare_to_baseline(best_hz: float, sweep: list) -> dict:
+    """Regression check against BENCH_SERVE_r06.json's chain mode; prints
+    a WARNING line on any regression (the bench contract)."""
+    path = os.path.join(_REPO, BASELINE)
+    cmp = {"baseline": BASELINE, "found": os.path.exists(path)}
+    if not cmp["found"]:
+        return cmp
+    with open(path) as f:
+        r06 = json.load(f)
+    chain = [r for r in r06.get("runs", [])
+             if r.get("batch_mode") == "chain"]
+    r06_rpd = max((r["completed"] / max(1, r["batches"]) for r in chain),
+                  default=1.0)
+    cmp["r06_best_hz"] = r06.get("value")
+    cmp["r06_chain_req_per_dispatch"] = round(r06_rpd, 2)
+    eng_rpd = max((row["req_per_dispatch"] for row in sweep
+                   if row["batch"] >= 2), default=0.0)
+    cmp["engine_req_per_dispatch_occ2plus"] = eng_rpd
+    cmp["throughput_regression"] = bool(
+        r06.get("value") and best_hz < r06["value"])
+    cmp["per_dispatch_regression"] = bool(eng_rpd <= r06_rpd)
+    for key, msg in (("throughput_regression",
+                      f"best {best_hz} req/s < r06 best {r06.get('value')}"),
+                     ("per_dispatch_regression",
+                      f"occupancy>=2 req/dispatch {eng_rpd} <= r06 chain "
+                      f"{r06_rpd:.2f}")):
+        if cmp[key]:
+            print(f"WARNING: serving regression vs {BASELINE}: {msg}",
+                  flush=True)
+    return cmp
 
 
 def main():
@@ -147,37 +242,39 @@ def main():
     solo_s = float(np.median(solo))
     solo_hz = 1.0 / solo_s
 
-    # --- offered loads vs batch settings.  Loads are relative to the solo
-    # rate: 0.7x (below capacity — latency should sit near solo), and 1.5x
-    # (beyond a single caller — only batching keeps up, shedding appears
-    # once the bounded queue saturates).
+    # --- the batch-N amortization curve at pinned occupancy
+    sweep = occupancy_sweep(cfg, variables, hw, iters, rng,
+                            rounds=4 if on_cpu else 6)
+
+    # --- offered loads.  Relative to the solo rate: 0.7x (below capacity —
+    # latency should sit near solo, batch 1 dominates) and 1.5x (beyond a
+    # single caller — continuous batching deepens occupancy to keep up).
     n_req = 48 if on_cpu else 120
-    settings = [
-        dict(max_batch=1, batch_mode="chain"),   # no batching: the control
-        dict(max_batch=4, batch_mode="chain"),
-        dict(max_batch=4, batch_mode="stack"),
-    ]
     runs = []
-    for s in settings:
+    for max_batch in (1, 8):
         for mult in (0.7, 1.5):
             runs.append(offered_load_run(
                 cfg, variables, hw, iters, rate_hz=mult * solo_hz,
-                n_requests=n_req, max_queue=16, rng=rng, **s))
+                n_requests=n_req, max_batch=max_batch, max_queue=16,
+                rng=rng))
             print(json.dumps(runs[-1]), flush=True)
 
     from raft_stereo_tpu.telemetry.events import bench_record, write_record
 
     best = max(runs, key=lambda r: r["throughput_hz"])
+    comparison = compare_to_baseline(best["throughput_hz"], sweep)
     rec = bench_record({
         "metric": "serve_throughput_hz",
         "value": best["throughput_hz"],
-        "unit": f"requests/s (serving path, {hw[0]}x{hw[1]}, iters={iters})",
+        "unit": f"requests/s (serving engine, {hw[0]}x{hw[1]}, "
+                f"iters={iters})",
         "platform": jax.devices()[0].platform,
         "solo_runner_hz": round(solo_hz, 2),
         "best_vs_solo": round(best["throughput_hz"] / solo_hz, 3),
-        "best_setting": {k: best[k] for k in
-                         ("max_batch", "batch_mode", "offered_hz")},
+        "best_setting": {k: best[k] for k in ("max_batch", "offered_hz")},
+        "occupancy_sweep": sweep,
         "runs": runs,
+        "baseline_comparison": comparison,
     })
     print(json.dumps(rec))
     write_record(os.path.join(_REPO, OUT), rec, indent=1)

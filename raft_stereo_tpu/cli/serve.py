@@ -1,8 +1,8 @@
-"""Stereo-depth serving CLI: a localhost HTTP API over the micro-batching
-service (serving/).
+"""Stereo-depth serving CLI: a localhost HTTP API over the batch-N
+serving engine (serving/engine.py).
 
     raft-serve --restore_ckpt models/raftstereo-realtime.pth \\
-        --port 8551 --max_batch 8 --max_wait_ms 5
+        --port 8551 --max_batch 8 --warmup_shape 375x1242
 
     # one request: left|right side-by-side PNG in, 16-bit disparity PNG out
     curl -s -X POST --data-binary @pair.png -H 'Content-Type: image/png' \\
@@ -26,16 +26,31 @@ from raft_stereo_tpu.cli import common
 log = logging.getLogger(__name__)
 
 
+def _parse_hw(text: str):
+    try:
+        h, w = text.lower().split("x")
+        return (int(h), int(w))
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"{text!r}: expected HxW, e.g. 375x1242") from e
+
+
 def build_service(args):
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
     cfg, variables = common.load_any_checkpoint(
         args.restore_ckpt, **common.arch_overrides(args))
+    # warmup_shapes stays out of the ServeConfig here: run_serve prewarms
+    # AFTER build_observability wires the event log into the cost
+    # registry, so the warmup compiles emit their "compile" run events.
     serve_cfg = ServeConfig(
-        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        max_queue=args.max_queue, batch_mode=args.batch_mode,
+        max_batch=args.max_batch,
+        batch_sizes=tuple(int(s) for s in args.batch_sizes.split(",")),
+        max_queue=args.max_queue,
         data_parallel=args.data_parallel, iters=args.valid_iters,
         shape_bucket=args.shape_bucket,
+        adaptive_buckets=args.adaptive_buckets,
+        max_padding_waste=args.max_padding_waste,
         fetch_dtype=args.fetch_dtype,
         default_deadline_ms=args.deadline_ms,
         trace_sample_rate=args.trace_sample_rate,
@@ -79,6 +94,10 @@ def run_serve(args) -> int:
 
     service = build_service(args)
     events, recorder, watchdog = build_observability(args, service)
+    for hw in (args.warmup_shape or ()):
+        # After the event log is wired: each ladder compile lands in the
+        # cost registry AND the run-event timeline.
+        service.prewarm(hw)
     server = StereoHTTPServer(service, host=args.host, port=args.port,
                               recorder=recorder)
     stop = threading.Event()
@@ -90,7 +109,7 @@ def run_serve(args) -> int:
             raise KeyboardInterrupt(f"second signal {signum}: force quit")
         log.warning("signal %d: draining (refusing new work, finishing "
                     "%d queued requests; send again to force-quit)",
-                    signum, service.batcher.depth)
+                    signum, service.queue.depth)
         stop.set()
         # shutdown() unblocks serve_forever below; drain happens after.
         threading.Thread(target=server.shutdown, daemon=True).start()
@@ -99,11 +118,11 @@ def run_serve(args) -> int:
         for sig in (signal.SIGTERM, signal.SIGINT):
             signal.signal(sig, _graceful)
 
-    log.info("serving on %s (max_batch=%d, max_wait=%.1f ms, queue<=%d, "
-             "%d device worker(s), mode=%s)", server.url,
-             service.serve_cfg.max_batch, service.serve_cfg.max_wait_ms,
-             service.serve_cfg.max_queue, len(service.devices),
-             service.serve_cfg.batch_mode)
+    log.info("serving on %s (batch sizes %s, queue<=%d, %d device "
+             "worker(s), %s buckets)", server.url,
+             service.queue.sizes, service.serve_cfg.max_queue,
+             len(service.devices),
+             "adaptive" if service.policy.adaptive else "static")
     try:
         server.serve_forever()
     finally:
@@ -111,7 +130,7 @@ def run_serve(args) -> int:
             watchdog.stop()
         if forced.is_set():
             log.warning("force quit: dropping %d queued requests",
-                        service.batcher.depth)
+                        service.queue.depth)
             service.close()
         else:
             drained = service.drain(timeout=args.drain_timeout_s)
@@ -133,23 +152,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--valid_iters", type=int, default=32,
                    help="GRU iterations per request")
     p.add_argument("--max_batch", type=int, default=8,
-                   help="flush a shape bucket at this many requests")
-    p.add_argument("--max_wait_ms", type=float, default=5.0,
-                   help="flush a partial bucket when its oldest request "
-                        "has waited this long")
+                   help="occupancy ceiling per device dispatch")
+    p.add_argument("--batch_sizes", default="1,2,4,8",
+                   help="comma list of batch sizes compiled per shape "
+                        "bucket (capped at max_batch; must include 1). "
+                        "The scheduler dispatches the largest size the "
+                        "queue depth fills and decomposes remainders — "
+                        "the batch axis never carries filler frames")
+    p.add_argument("--max_wait_ms", type=float, default=0.0,
+                   help="RETIRED: continuous batching dispatches the "
+                        "moment a worker is free; accepted and ignored")
     p.add_argument("--max_queue", type=int, default=64,
                    help="admission bound; beyond it requests get 429")
-    p.add_argument("--batch_mode", default="chain",
-                   choices=["chain", "stack"],
-                   help="chain: N batch-1 dispatches, bitwise-equal to solo "
-                        "inference; stack: one batched dispatch per flush, "
-                        "batch-padded to the next power of two (max "
-                        "amortization, ~1e-5 numeric drift)")
     p.add_argument("--data_parallel", type=int, default=1,
                    help="device workers (each on its own local device)")
     p.add_argument("--shape_bucket", type=int, default=None,
-                   help="pad to this grid instead of /32 (coarser buckets "
-                        "batch more shapes together per compile)")
+                   help="pad to this static grid instead of /32 (coarser "
+                        "buckets batch more shapes together per compile)")
+    p.add_argument("--adaptive_buckets", action="store_true",
+                   help="waste-driven bucket selection: shapes start at "
+                        "the coarsest pad grid and a bucket is refined "
+                        "toward /32 once its measured padding waste "
+                        "exceeds --max_padding_waste")
+    p.add_argument("--max_padding_waste", type=float, default=0.10,
+                   help="adaptive-bucket refinement threshold: measured "
+                        "waste fraction above which a coarse bucket is "
+                        "split to the next finer grid")
+    p.add_argument("--warmup_shape", type=_parse_hw, action="append",
+                   help="raw HxW whose bucket ladder (all batch sizes) is "
+                        "compiled at boot (repeatable), e.g. 375x1242 — "
+                        "cold-start compiles move out of the first "
+                        "requests' path")
     p.add_argument("--deadline_ms", type=float, default=None,
                    help="default per-request queue deadline (504 past it; "
                         "X-Deadline-Ms header overrides)")

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+import warnings
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -23,12 +24,67 @@ from raft_stereo_tpu.ops.padding import InputPadder
 
 log = logging.getLogger(__name__)
 
+# Donated image buffers alias an output only when XLA finds one of the
+# same byte size; the stereo forward returns a 1-channel f32 flow, so the
+# 3-channel uint8 inputs never pair and every backend warns once per
+# compile.  The donation is still declared (caller contract: inputs are
+# consumed) so any future same-size output — warm-start state, multi-head
+# returns — aliases without touching the dispatch sites; the warning is
+# pure noise for this program shape.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
 # GRU-iteration depth at which bf16 correlation measurably drifts on TRAINED
 # weights: at iters=32 the per-pixel p99 reaches ~6.5-7 px with ΔEPE +0.04 px
 # (BF16_DRIFT_r03.json), while at the realtime depth (7) drift is ≤0.03 px
 # EPE.  Eval/demo runs at or past this depth flip the correlation features to
 # fp32 (everything else stays bf16) unless the caller opts out.
 DEEP_ITERS_FP32_CORR = 16
+
+
+def effective_inference_config(config: RaftStereoConfig, iters: int,
+                               corr_fp32_auto: bool = True
+                               ) -> RaftStereoConfig:
+    """The config an inference path should actually run: deep-iteration
+    bf16 correlation gets ``corr_fp32`` flipped on (the measured 32-iter
+    drift on trained weights, BF16_DRIFT_r03.json).  Shared by the solo
+    ``InferenceRunner`` and the serving engine so both compile the same
+    program for the same request class — the engine's batch-1 bucket is
+    bitwise-equal to solo inference by construction."""
+    if (corr_fp32_auto and iters >= DEEP_ITERS_FP32_CORR
+            and config.mixed_precision and not config.corr_fp32):
+        log.warning(
+            "iters=%d >= %d with bf16 correlation: enabling corr_fp32 "
+            "for this runner (measured 32-iter drift on trained "
+            "weights, BF16_DRIFT_r03.json; pass corr_fp32_auto=False "
+            "to keep bf16 corr)", iters, DEEP_ITERS_FP32_CORR)
+        return dataclasses.replace(config, corr_fp32=True)
+    return config
+
+
+def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
+                 donate_images: bool = True):
+    """The one jitted inference program both the solo runner and the
+    serving engine compile, per (padded shape, batch): cast -> forward ->
+    optional half-precision fetch cast.  Built here so the two paths share
+    one jaxpr by construction (the serving parity contract).
+
+    ``donate_images`` marks the image arguments donated
+    (``donate_argnums``): both call sites upload fresh per-call device
+    buffers, so the runtime is free to reclaim or alias them the moment
+    the program consumes them.  Donation never changes numerics (tested)
+    and the module-level filter above silences XLA's not-usable note for
+    output shapes that cannot alias."""
+    def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
+        img1 = images1.astype(jnp.float32)
+        img2 = images2.astype(jnp.float32)
+        _, flow_up = model.apply(variables, img1, img2, iters=iters,
+                                 test_mode=True)
+        if fetch_dtype is not None:
+            flow_up = flow_up.astype(fetch_dtype)
+        return flow_up
+
+    return jax.jit(fwd, donate_argnums=(1, 2) if donate_images else ())
 
 
 class InferenceRunner:
@@ -44,7 +100,8 @@ class InferenceRunner:
                  max_cached_shapes: int = 16,
                  corr_fp32_auto: bool = True,
                  fetch_dtype: Optional[str] = None,
-                 cost_registry=None, cost_site: str = "eval"):
+                 cost_registry=None, cost_site: str = "eval",
+                 donate_images: bool = True):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -86,16 +143,8 @@ class InferenceRunner:
         # against their own (eval.validate.make_validation_fn re-creates the
         # runner on mismatch); the guard's flip lives in effective_config.
         self.config = config
-        self.effective_config = config
-        if (corr_fp32_auto and iters >= DEEP_ITERS_FP32_CORR
-                and config.mixed_precision and not config.corr_fp32):
-            self.effective_config = dataclasses.replace(config,
-                                                        corr_fp32=True)
-            log.warning(
-                "iters=%d >= %d with bf16 correlation: enabling corr_fp32 "
-                "for this runner (measured 32-iter drift on trained "
-                "weights, BF16_DRIFT_r03.json; pass corr_fp32_auto=False "
-                "to keep bf16 corr)", iters, DEEP_ITERS_FP32_CORR)
+        self.effective_config = effective_inference_config(
+            config, iters, corr_fp32_auto)
         self.variables = variables
         self.iters = iters
         self.divis_by = shape_bucket or divis_by
@@ -108,6 +157,7 @@ class InferenceRunner:
         self.model = RAFTStereo(self.effective_config)
         self.cost_registry = cost_registry
         self.cost_site = cost_site
+        self.donate_images = donate_images
         self._compiled: Dict[Tuple[int, int], any] = {}
 
     def _cost_key(self, padded_hw: Tuple[int, int], batch: int) -> str:
@@ -150,19 +200,8 @@ class InferenceRunner:
                 if self.cost_registry is not None:
                     self.cost_registry.note_runner_eviction(
                         self._cost_key(*evicted), len(self._compiled))
-            model, iters = self.model, self.iters
-            fetch_dtype = self.fetch_dtype
-
-            @jax.jit
-            def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
-                img1 = images1.astype(jnp.float32)
-                img2 = images2.astype(jnp.float32)
-                _, flow_up = model.apply(variables, img1, img2, iters=iters,
-                                         test_mode=True)
-                if fetch_dtype is not None:
-                    flow_up = flow_up.astype(fetch_dtype)
-                return flow_up
-
+            fwd = make_forward(self.model, self.iters, self.fetch_dtype,
+                               donate_images=self.donate_images)
             if self.cost_registry is not None:
                 # AOT-instrumented dispatch: first call lowers + compiles
                 # through the registry (cost/memory analysis recorded),

@@ -1,13 +1,18 @@
-"""Inference serving subsystem: dynamic micro-batching over shape buckets,
-admission control + backpressure, device worker pool, and a plain-text
-metrics endpoint.  See docs/architecture.md §Serving."""
+"""Inference serving subsystem: the batch-N serving engine — bucketed
+batch executables, continuous batching, admission control + backpressure,
+waste-driven bucket selection, and a plain-text metrics endpoint.  See
+docs/architecture.md §Serving."""
 
-from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
-                                             Overloaded, Request)
+from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
+                                             Overloaded, Request,
+                                             decompose_batch,
+                                             pick_batch_size)
+from raft_stereo_tpu.serving.engine import (BucketPolicy, ServeConfig,
+                                            ServeResult, ServingEngine,
+                                            StereoService)
 from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
-from raft_stereo_tpu.serving.service import (ServeConfig, ServeResult,
-                                             StereoService)
 
-__all__ = ["DeadlineExceeded", "MicroBatcher", "Overloaded", "Request",
+__all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
+           "decompose_batch", "pick_batch_size", "BucketPolicy",
            "MetricsRegistry", "ServingMetrics", "ServeConfig", "ServeResult",
-           "StereoService"]
+           "ServingEngine", "StereoService"]

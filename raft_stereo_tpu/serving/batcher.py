@@ -1,19 +1,30 @@
-"""Dynamic micro-batcher: bounded queue, shape-bucket grouping, timed flush.
+"""Continuous-batching request queue: bounded admission, shape buckets,
+worker-pull dispatch with batch-size bucket selection.
 
-The serving front door (service.StereoService.submit) turns each stereo pair
-into a ``Request`` and offers it here.  The batcher groups compatible
-requests by their padded-shape bucket — RAFT-Stereo's fixed-iteration GRU
-loop makes per-frame device time a function of the padded shape alone
-(PAPER.md §1), so same-bucket requests batch with zero compute waste — and
-flushes a bucket when it reaches ``max_batch`` or its oldest request has
-waited ``max_wait_ms``.  Admission control is a hard bound on queued
-requests: past ``max_queue`` the submit raises the typed ``Overloaded``
-(load shedding at the door beats collapsing under a backlog), and during a
-drain new work is refused the same way while queued work finishes.
+The serving engine (serving/engine.py) turns each stereo pair into a
+``Request`` and offers it here.  Requests group by their padded-shape
+bucket — RAFT-Stereo's fixed-iteration GRU loop makes per-frame device
+time a function of the padded shape alone (PAPER.md §1), so same-bucket
+requests batch with zero compute waste.  Admission control is a hard bound
+on queued requests: past ``max_queue`` the submit raises the typed
+``Overloaded`` (load shedding at the door beats collapsing under a
+backlog), and during a drain new work is refused the same way while queued
+work finishes.
 
-Model-agnostic on purpose: ``dispatch(batch)`` is an injected callable (the
-service routes it to a device worker pool), so every queueing policy in this
-file is testable without touching JAX.
+Dispatch is **continuous batching**: there is no flush thread and no
+``max_wait`` stall — a device worker that goes idle calls ``pop`` and
+immediately takes whatever is queued.  ``pop`` picks the bucket whose head
+request has waited longest and takes the largest configured batch size the
+bucket's depth fills (``pick_batch_size``), so occupancy is set by queue
+pressure, not by a timer: below capacity every request dispatches the
+moment a worker is free (batch 1, minimum latency); once workers are busy
+the queue deepens and the next pop grabs a 4 or an 8.  This replaced the
+round-6 MicroBatcher, whose timed flush left the device idle while
+requests aged toward ``max_wait_ms`` (BENCH_SERVE_r06.json: queue-wait p95
+~4 s at offered 1.91 Hz with the device under-occupied).
+
+Model-agnostic on purpose: the queue never touches JAX, so every
+scheduling policy in this file is testable in milliseconds.
 """
 
 from __future__ import annotations
@@ -22,7 +33,7 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from raft_stereo_tpu.serving.metrics import ServingMetrics
 
@@ -41,13 +52,13 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before a device picked it up."""
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)   # identity equality: payloads hold arrays
 class Request:
-    """One queued stereo pair.  ``payload`` is opaque to the batcher (the
-    service stores images + padder there); ``bucket`` keys compatibility.
+    """One queued stereo pair.  ``payload`` is opaque to the queue (the
+    engine stores images + padder there); ``bucket`` keys compatibility.
     ``trace``/``queue_span`` are likewise opaque (telemetry/spans.py
-    handles of a sampled request — the service opens/closes them; the
-    batcher only carries them across its threads)."""
+    handles of a sampled request — the engine opens/closes them; the
+    queue only carries them across its threads)."""
 
     bucket: Tuple[int, int]
     payload: object
@@ -61,40 +72,74 @@ class Request:
         return self.deadline is not None and now > self.deadline
 
 
-class MicroBatcher:
-    """Bucketed request queue + flush thread.
+def pick_batch_size(depth: int, sizes: Sequence[int]) -> int:
+    """The batch size a pop at queue depth ``depth`` dispatches: the
+    largest compiled bucket size the depth fills.  A partial batch (depth
+    between two sizes) dispatches at the next size down rather than being
+    padded up — the batch axis carries no filler frames, ever; the
+    remainder stays queued and the next free worker takes it immediately.
+    ``sizes`` must be ascending and start at 1 (the engine validates)."""
+    if depth < 1:
+        raise ValueError(f"depth={depth} must be >= 1")
+    fit = [s for s in sizes if s <= depth]
+    if not fit:
+        raise ValueError(f"no batch size in {tuple(sizes)} fits depth "
+                         f"{depth}; sizes must include 1")
+    return fit[-1]
 
-    ``dispatch(requests)`` runs on the flush thread and is expected to BLOCK
-    when the downstream worker pool is saturated — that stall is the
-    backpressure path: flushing pauses, the queue fills, and submits shed at
-    the ``max_queue`` bound instead of growing an unbounded backlog.
+
+def decompose_batch(n: int, sizes: Sequence[int]) -> List[int]:
+    """Split ``n`` requests into dispatch chunks of configured sizes,
+    largest-first (greedy): 7 -> [4, 2, 1] with the default 1/2/4/8 set.
+    Used when deadline triage shrinks a popped batch below the size the
+    scheduler picked — every device dispatch still runs a compiled
+    batch-size bucket, never an ad-hoc batch axis."""
+    out: List[int] = []
+    while n > 0:
+        k = pick_batch_size(n, sizes)
+        out.append(k)
+        n -= k
+    return out
+
+
+class BucketQueue:
+    """Bucketed request queue for continuous batching.
+
+    ``submit`` is the bounded front door (``Overloaded`` past ``max_queue``
+    or while draining); ``pop`` is the worker side — it blocks until work
+    is queued, then returns the oldest bucket's head requests at the batch
+    size ``pick_batch_size`` selects.  Backpressure needs no extra
+    machinery: a saturated worker pool simply stops popping, the queue
+    fills, and submits shed at the bound.
     """
 
-    def __init__(self, dispatch: Callable[[List[Request]], None],
-                 max_batch: int = 8, max_wait_ms: float = 5.0,
+    def __init__(self, max_batch: int = 8,
+                 batch_sizes: Sequence[int] = (1, 2, 4, 8),
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock=time.monotonic):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
-        self._dispatch = dispatch
+        sizes = sorted(set(int(s) for s in batch_sizes if s <= max_batch))
+        if not sizes or sizes[0] != 1 or any(s < 1 for s in sizes):
+            raise ValueError(
+                f"batch_sizes={tuple(batch_sizes)} must be positive and "
+                f"include 1 after capping at max_batch={max_batch}")
+        self.sizes = tuple(sizes)
         self.max_batch = max_batch
-        self.max_wait_s = max_wait_ms / 1e3
         self.max_queue = max_queue
         self.metrics = metrics or ServingMetrics(max_batch=max_batch)
         self._clock = clock
         self._cond = threading.Condition()
-        # bucket -> FIFO of requests; dict preserves insertion order so the
-        # flush scan visits oldest buckets first
+        # bucket -> FIFO of requests; the pop scan picks the bucket whose
+        # head request has waited longest (global FIFO across buckets).
         self._buckets: Dict[Tuple[int, int], List[Request]] = {}
         self._depth = 0
         self._draining = False
         self._closed = False
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="stereo-batcher")
-        self._thread.start()
+        self._paused = False   # test hook: stage submits, then release
 
     # ------------------------------------------------------------ admission
     @property
@@ -124,63 +169,80 @@ class MicroBatcher:
             self.metrics.queue_depth.set(self._depth)
             self._cond.notify()
 
-    # ---------------------------------------------------------------- flush
-    def _ready_bucket(self, now: float) -> Optional[Tuple[int, int]]:
-        """Oldest bucket due for flush: full, past max_wait, or draining."""
-        for key, reqs in self._buckets.items():
-            if (len(reqs) >= self.max_batch or self._draining
-                    or now - reqs[0].t_enqueue >= self.max_wait_s):
-                return key
-        return None
+    # ----------------------------------------------------------------- pop
+    def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
+        key, oldest = None, None
+        for k, reqs in self._buckets.items():
+            if reqs and (oldest is None or reqs[0].t_enqueue < oldest):
+                key, oldest = k, reqs[0].t_enqueue
+        return key
 
-    def _next_due(self, now: float) -> Optional[float]:
-        """Seconds until the earliest bucket hits max_wait; None if empty."""
-        if not self._buckets:
-            return None
-        oldest = min(r[0].t_enqueue for r in self._buckets.values())
-        return max(0.0, oldest + self.max_wait_s - now)
+    def pop(self, timeout: Optional[float] = None) -> Optional[List[Request]]:
+        """Take the next dispatch batch, blocking until one is available.
 
-    def _run(self) -> None:
+        Returns the oldest bucket's head ``pick_batch_size(depth)``
+        requests with deadline-expired ones triaged out (their futures
+        fail with ``DeadlineExceeded``), or None when the queue is closed
+        (worker shutdown) or ``timeout`` elapsed.  The survivors are
+        counted into ``metrics.inflight`` before the lock drops, so
+        ``drain``'s depth==0 + inflight==0 check never misses a batch in
+        hand."""
+        deadline = None if timeout is None else self._clock() + timeout
         while True:
             with self._cond:
-                now = self._clock()
-                key = self._ready_bucket(now)
-                while key is None and not self._closed:
-                    self._cond.wait(timeout=self._next_due(now))
-                    now = self._clock()
-                    key = self._ready_bucket(now)
-                if key is None and self._closed:
-                    return
-                reqs = self._buckets.pop(key)
-                batch, rest = reqs[:self.max_batch], reqs[self.max_batch:]
-                if rest:  # burst bigger than max_batch: keep FIFO order
-                    # reinsertion puts the remainder last in the scan order,
-                    # but its t_enqueue keeps it due immediately
+                while not self._closed and (
+                        self._paused or self._oldest_bucket() is None):
+                    remaining = (None if deadline is None
+                                 else deadline - self._clock())
+                    if remaining is not None and remaining <= 0:
+                        return None
+                    self._cond.wait(timeout=remaining)
+                if self._closed:
+                    return None
+                key = self._oldest_bucket()
+                reqs = self._buckets[key]
+                k = pick_batch_size(len(reqs), self.sizes)
+                batch, rest = reqs[:k], reqs[k:]
+                if rest:
                     self._buckets[key] = rest
+                else:
+                    del self._buckets[key]
                 self._depth -= len(batch)
                 self.metrics.queue_depth.set(self._depth)
+                # Deadline triage inside the lock's shadow: expired
+                # requests never count inflight.
+                now = self._clock()
+                live = [r for r in batch if not r.expired(now)]
+                expired = [r for r in batch if r.expired(now)]
+                self.metrics.inflight.inc(len(live))
                 self._cond.notify_all()  # wake drain() waiters
-            # Outside the lock: deadline triage + the (blocking) dispatch.
-            live: List[Request] = []
-            now = self._clock()
-            for r in batch:
-                if r.expired(now):
-                    self.metrics.deadline_missed.inc()
-                    r.future.set_exception(DeadlineExceeded(
-                        f"deadline passed after "
-                        f"{(now - r.t_enqueue) * 1e3:.1f} ms in queue"))
-                else:
-                    live.append(r)
+            for r in expired:
+                self.metrics.deadline_missed.inc()
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed after "
+                    f"{(now - r.t_enqueue) * 1e3:.1f} ms in queue"))
             if live:
-                self._dispatch(live)
+                return live
+            # every popped request had expired: go take the next batch
+
+    # ------------------------------------------------------------ test hook
+    def pause(self) -> None:
+        """Stage mode for tests: submits queue up but ``pop`` blocks, so a
+        test can build an exact queue depth before releasing the workers."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
 
     # ---------------------------------------------------------------- drain
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Stop admitting (submits raise ``Overloaded``), flush all queued
-        requests immediately (no max_wait stalling), and wait until the
-        queue is empty.  Returns False on timeout.  Dispatched batches may
-        still be running on workers — the service waits for those
-        separately."""
+        """Stop admitting (submits raise ``Overloaded``) and wait until the
+        workers have popped everything queued.  Returns False on timeout.
+        Popped batches may still be running on workers — the engine waits
+        on ``metrics.inflight`` separately."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
             self._draining = True
@@ -194,8 +256,9 @@ class MicroBatcher:
         return True
 
     def close(self) -> None:
-        """Stop the flush thread.  Queued requests (drain not called, or
-        timed out) fail with ``Overloaded`` rather than hanging forever."""
+        """Stop the queue: blocked ``pop`` calls return None (worker
+        shutdown), and queued requests (drain not called, or timed out)
+        fail with ``Overloaded`` rather than hanging forever."""
         with self._cond:
             self._closed = True
             self._draining = True
@@ -208,7 +271,6 @@ class MicroBatcher:
             r.future.set_exception(
                 Overloaded("service shut down before this request ran",
                            draining=True))
-        self._thread.join(timeout=5.0)
 
 
 def drain_order(batches: Sequence[Sequence[Request]]) -> List[Request]:

@@ -1,0 +1,680 @@
+"""The batch-N serving engine: compile cache, scheduler, and cost
+telemetry in one place.
+
+This replaced the round-6 split of ``StereoService`` + ``MicroBatcher`` +
+per-worker ``InferenceRunner``.  That stack's best throughput was 1.015x
+solo inference (BENCH_SERVE_r06.json): its default "chain" mode dispatched
+the batch-1 program serially per request, its "stack" mode re-padded the
+batch axis to the next power of two and lost more than it gained, and its
+timed flush left the device idle while requests aged toward
+``max_wait_ms``.  The engine fixes all three:
+
+* **True batch-N bucket executables** — one compiled program per
+  (padded shape, batch size) for the configured ``batch_sizes``
+  (default 1/2/4/8), image buffers donated (``donate_argnums``), built by
+  the same ``eval.runner.make_forward`` the solo runner uses — so the
+  batch-1 bucket is **bitwise-equal** to solo inference by construction
+  (the old chain mode survives as exactly that bucket).  Compiles route
+  through the ``CompileRegistry`` AOT path when cost telemetry is on, and
+  ``prewarm`` builds a shape's whole bucket ladder at boot.
+* **Continuous batching** (`serving/batcher.py BucketQueue`) — no flush
+  thread, no ``max_wait`` stall: an idle worker pops immediately and takes
+  the largest compiled batch size the queue depth fills; a partial batch
+  dispatches at the next size down (7 queued -> 4 + 2 + 1), never padded
+  up.  Occupancy is set by queue pressure: below capacity every request
+  dispatches the moment a worker frees (batch 1, minimum latency); at
+  pressure the pops grab 4s and 8s.
+* **Waste-driven bucket selection** (``BucketPolicy``) — the measured
+  ``serve_padding_waste`` / ``serve_bucket_*_pixels_total`` accounting
+  feeds back into the spatial padding policy: in adaptive mode shapes
+  start at the coarsest pad grid (maximal executable reuse) and a bucket
+  is refined toward the /32 floor once its observed waste fraction
+  crosses ``max_padding_waste``.  The static /32 rule remains the default
+  (the reference's padding semantics; parity tests require it).
+
+Shutdown mirrors the train loop's preemption story
+(training/train_loop.py): ``drain()`` refuses new work with the typed
+``Overloaded``, lets the workers finish the queue, and only then stops
+them.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu import profiling
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.eval.runner import (effective_inference_config,
+                                         make_forward)
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+from raft_stereo_tpu.ops.padding import InputPadder
+from raft_stereo_tpu.serving.batcher import (BucketQueue, Overloaded,
+                                             Request, decompose_batch)
+from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
+
+log = logging.getLogger(__name__)
+
+# The model's divisibility constraint: every pad grid must be a multiple
+# of this, and the adaptive policy can never refine below it.
+MODEL_DIVIS = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (model architecture stays in RaftStereoConfig)."""
+
+    max_batch: int = 8           # occupancy ceiling per device dispatch
+    # Batch sizes compiled per shape bucket; capped at max_batch, must
+    # include 1 (the solo-parity bucket).  The scheduler dispatches the
+    # largest size the queue depth fills and decomposes remainders
+    # (7 queued -> 4+2+1) — the batch axis never carries filler frames.
+    batch_sizes: Tuple[int, ...] = (1, 2, 4, 8)
+    # RETIRED (round 11): the engine's continuous batching dispatches the
+    # moment a worker is free, so there is no timed flush to bound.  The
+    # field is accepted for compatibility and ignored.
+    max_wait_ms: float = 0.0
+    max_queue: int = 64          # admission bound; beyond it -> Overloaded
+    data_parallel: int = 1       # device workers (<= local device count)
+    iters: int = 32              # GRU iterations per request
+    shape_bucket: Optional[int] = None   # static coarser-than-/32 pad grid
+    # Waste-driven spatial bucket selection: start shapes at the coarsest
+    # grid in bucket_grids and refine a bucket toward the /32 floor once
+    # its measured padding-waste fraction exceeds max_padding_waste.
+    # Off by default: the static /32 rule is the reference's padding
+    # semantics and the bitwise parity tests require it.
+    adaptive_buckets: bool = False
+    bucket_grids: Tuple[int, ...] = (128, 64, 32)
+    max_padding_waste: float = 0.10
+    # Raw (H, W) shapes whose bucket ladder (all batch sizes) is compiled
+    # at boot — cold-start work moved out of the first requests' path.
+    warmup_shapes: Tuple[Tuple[int, int], ...] = ()
+    max_cached_shapes: int = 16  # per-worker (bucket, batch) executables
+    fetch_dtype: Optional[str] = None    # "fp16" | "bf16" half fetch
+    default_deadline_ms: Optional[float] = None  # per-request override wins
+    # Donate the image buffers to every bucket executable (and declare the
+    # same on the solo runner): the runtime may reclaim/alias them the
+    # moment the program consumes them.  Numerics-neutral (tested).
+    donate_buffers: bool = True
+    # Fraction of requests whose span tree is recorded (telemetry/spans.py:
+    # admission -> queue -> dispatch -> fetch -> respond, exported as
+    # Chrome trace JSON via GET /debug/spans).  0.0 (default) disables
+    # tracing entirely — every span site takes the constant-time None exit.
+    trace_sample_rate: float = 0.0
+    # Compile-cost telemetry (telemetry/costs.py): route every bucket
+    # compile through the AOT path so GET /debug/compiles lists each
+    # executable's flops/bytes/memory and the MFU gauges get their flops
+    # numerator.  False (default) keeps the plain jax.jit dispatch.
+    cost_telemetry: bool = False
+    # MFU denominator override (TFLOP/s); None = the auto table keyed by
+    # the local device kind (costs.DEVICE_PEAK_TFLOPS).
+    device_peak_tflops: Optional[float] = None
+
+    def __post_init__(self):
+        if self.data_parallel < 1:
+            raise ValueError(f"data_parallel={self.data_parallel} must be "
+                             f">= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(f"trace_sample_rate={self.trace_sample_rate} "
+                             f"must be in [0, 1]")
+        sizes = tuple(sorted(set(int(s) for s in self.batch_sizes)))
+        if not sizes or sizes[0] < 1:
+            raise ValueError(
+                f"batch_sizes={self.batch_sizes} must be positive ints")
+        if 1 not in sizes:
+            raise ValueError(
+                f"batch_sizes={self.batch_sizes} must include 1 (the "
+                f"solo-parity bucket every partial batch bottoms out at)")
+        if self.shape_bucket is not None and self.shape_bucket % MODEL_DIVIS:
+            raise ValueError(
+                f"shape_bucket={self.shape_bucket} must be a multiple of "
+                f"the model's /{MODEL_DIVIS} divisibility requirement")
+        if not 0.0 < self.max_padding_waste < 1.0:
+            raise ValueError(f"max_padding_waste={self.max_padding_waste} "
+                             f"must be in (0, 1)")
+        if self.fetch_dtype not in (None, "fp16", "bf16"):
+            raise ValueError(f"fetch_dtype={self.fetch_dtype!r}: use "
+                             f"'fp16', 'bf16', or None (full fp32 fetch)")
+        for g in self.bucket_grids:
+            if g < MODEL_DIVIS or g % MODEL_DIVIS:
+                raise ValueError(
+                    f"bucket_grids={self.bucket_grids}: every grid must be "
+                    f"a multiple of /{MODEL_DIVIS}")
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One answered request: the flow plus its latency decomposition."""
+
+    flow: np.ndarray             # (H, W) x-flow (= -disparity), float32
+    queue_wait_s: float          # admission -> worker pickup
+    device_s: float              # dispatch -> outputs ready (advisory
+    #                              behind an async tunnel; see metrics.py)
+    fetch_s: float               # device->host result transfer
+    total_s: float               # admission -> result ready
+    batch_size: int              # occupancy of the dispatch it rode in
+
+    @property
+    def disparity(self) -> np.ndarray:
+        """Positive disparity (the user-facing convention, cli/demo.py)."""
+        return -self.flow
+
+
+@dataclasses.dataclass
+class _Payload:
+    """What the engine parks in Request.payload: padded inputs + unpadder."""
+
+    left: np.ndarray             # (Hp, Wp, 3) host-padded
+    right: np.ndarray
+    padder: InputPadder
+
+
+class BucketPolicy:
+    """Maps a raw image (H, W) to its padded dispatch bucket (Hp, Wp).
+
+    Static mode (``grids`` of length 1): the fixed grid — /32 by default,
+    or ``ServeConfig.shape_bucket`` — exactly the reference's padding
+    semantics.
+
+    Adaptive mode: a shape starts at the COARSEST grid (coarse buckets
+    collapse more raw shapes into one compiled ladder, so compiles and
+    cold starts are fewest), and ``note`` — fed the same per-dispatch
+    real/padding pixel counts as the ``serve_bucket_*_pixels_total``
+    counters — refines a bucket to the next finer grid once its measured
+    cumulative waste fraction exceeds ``max_waste``.  Refinement is
+    monotonic and bottoms out at the /32 floor, which the model's
+    divisibility constraint makes irreducible.
+    """
+
+    def __init__(self, grids: Sequence[int] = (MODEL_DIVIS,),
+                 max_waste: float = 0.10, min_observe_px: int = 0,
+                 refinements_counter=None):
+        grids = sorted(set(int(g) for g in grids), reverse=True)
+        if not grids or any(g % MODEL_DIVIS or g < MODEL_DIVIS
+                            for g in grids):
+            raise ValueError(f"grids={grids} must be multiples of "
+                             f"/{MODEL_DIVIS}")
+        self.grids = tuple(grids)         # coarsest first
+        self.max_waste = max_waste
+        self.min_observe_px = min_observe_px
+        self._lock = threading.Lock()
+        self._px: Dict[Tuple[int, int], List[int]] = {}  # bucket -> [real,
+        #                                                   dispatched]
+        self._refined: set = set()        # buckets past the waste bound
+        self._refinements = refinements_counter
+        self.adaptive = len(self.grids) > 1
+
+    @staticmethod
+    def _pad_to(h: int, w: int, grid: int) -> Tuple[int, int]:
+        return (-(-h // grid) * grid, -(-w // grid) * grid)
+
+    def bucket_for(self, h: int, w: int) -> Tuple[int, int, int]:
+        """The (Hp, Wp, grid) this raw shape dispatches at: the coarsest
+        grid whose bucket has not been refined away (the finest grid is
+        always accepted)."""
+        with self._lock:
+            for g in self.grids[:-1]:
+                bucket = self._pad_to(h, w, g)
+                if bucket not in self._refined:
+                    return bucket + (g,)
+            g = self.grids[-1]
+            return self._pad_to(h, w, g) + (g,)
+
+    def note(self, bucket: Tuple[int, int], real_px: int,
+             dispatched_px: int) -> None:
+        """Per-dispatch waste feedback (the engine calls this alongside
+        ``ServingMetrics.observe_padding``).  Crossing ``max_waste``
+        refines the bucket: subsequent shapes that would have used it route
+        to the next finer grid instead."""
+        if not self.adaptive or dispatched_px <= 0:
+            return
+        with self._lock:
+            if bucket in self._refined:
+                return
+            acc = self._px.setdefault(tuple(bucket), [0, 0])
+            acc[0] += real_px
+            acc[1] += dispatched_px
+            if acc[1] < max(self.min_observe_px, 1):
+                return
+            waste = 1.0 - acc[0] / acc[1]
+            if waste > self.max_waste:
+                self._refined.add(tuple(bucket))
+                log.info(
+                    "bucket %sx%s refined: measured padding waste %.1f%% "
+                    "> %.1f%% over %d dispatched pixels — shapes re-route "
+                    "to the next finer pad grid",
+                    bucket[0], bucket[1], waste * 100,
+                    self.max_waste * 100, acc[1])
+                if self._refinements is not None:
+                    self._refinements.inc()
+
+    @property
+    def refined_buckets(self) -> Tuple[Tuple[int, int], ...]:
+        with self._lock:
+            return tuple(sorted(self._refined))
+
+
+class ServingEngine:
+    """The unified serving engine: one object owning the batch-N compile
+    cache, the continuous-batching scheduler, the device worker pool, and
+    the cost/waste telemetry loop.
+
+    ``devices`` defaults to the first ``serve_cfg.data_parallel`` local JAX
+    devices; each gets a worker thread with the variables resident on that
+    device.  The public surface is unchanged from the round-6
+    ``StereoService`` (``submit``/``infer``/``drain``/``close``), which
+    remains as an alias.
+    """
+
+    def __init__(self, config: RaftStereoConfig, variables,
+                 serve_cfg: ServeConfig = ServeConfig(),
+                 devices: Optional[Sequence] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None):
+        import jax
+
+        from raft_stereo_tpu.telemetry.spans import SpanTracer
+
+        self.serve_cfg = serve_cfg
+        # Request-path span tracer (telemetry/spans.py).  At the default
+        # sample rate 0.0 every start_trace returns None and the span
+        # plumbing below is a handful of no-op attribute checks per
+        # request — serving numerics and dispatch behavior are untouched.
+        self.tracer = (tracer if tracer is not None
+                       else SpanTracer(serve_cfg.trace_sample_rate))
+        if devices is None:
+            local = jax.local_devices()
+            if serve_cfg.data_parallel > len(local):
+                raise ValueError(
+                    f"data_parallel={serve_cfg.data_parallel} exceeds the "
+                    f"{len(local)} local devices")
+            devices = local[:serve_cfg.data_parallel]
+        self.devices = list(devices)
+        self.metrics = ServingMetrics(registry,
+                                      max_batch=serve_cfg.max_batch)
+        # Compile-cost registry (telemetry/costs.py): one per engine,
+        # shared by all workers — same bucket => same program => one cost
+        # record per (shape, batch) key.  None (default) leaves the jit
+        # dispatch untouched.
+        self.costs = None
+        self._mfu = None
+        if serve_cfg.cost_telemetry:
+            from raft_stereo_tpu.telemetry.costs import (CompileRegistry,
+                                                         MfuMeter)
+            self.costs = CompileRegistry(
+                registry=self.metrics.registry,
+                device_peak_tflops=serve_cfg.device_peak_tflops)
+            self._mfu = MfuMeter(
+                self.metrics.mfu, self.costs.peak_flops,
+                achieved_gauge=self.metrics.achieved_flops_per_s)
+        # The spatial padding policy: static /32 (or shape_bucket) unless
+        # adaptive_buckets turns on the waste feedback loop.
+        if serve_cfg.adaptive_buckets:
+            grids = tuple(serve_cfg.bucket_grids) + (
+                serve_cfg.shape_bucket or MODEL_DIVIS,)
+        else:
+            grids = (serve_cfg.shape_bucket or MODEL_DIVIS,)
+        self.policy = BucketPolicy(
+            grids=grids, max_waste=serve_cfg.max_padding_waste,
+            refinements_counter=self.metrics.bucket_refinements)
+        # The model, with the same deep-iteration corr_fp32 guard the solo
+        # runner applies — both paths compile the identical program.
+        self.config = config
+        self.effective_config = effective_inference_config(
+            config, serve_cfg.iters)
+        self.model = RAFTStereo(self.effective_config)
+        # Per-worker resident variables + the engine-owned executable
+        # cache: (worker, padded shape, batch size) -> compiled forward,
+        # bounded per worker, oldest evicted.
+        self._worker_vars = [jax.device_put(variables, d)
+                             for d in self.devices]
+        self._cache_lock = threading.Lock()
+        self._compiled: "collections.OrderedDict[Tuple, object]" = (
+            collections.OrderedDict())
+        self.queue = BucketQueue(
+            max_batch=serve_cfg.max_batch,
+            batch_sizes=serve_cfg.batch_sizes,
+            max_queue=serve_cfg.max_queue, metrics=self.metrics)
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._worker_loop, args=(i,),
+                             daemon=True, name=f"stereo-worker-{i}")
+            for i in range(len(self.devices))]
+        for t in self._workers:
+            t.start()
+        for hw in serve_cfg.warmup_shapes:
+            self.prewarm(hw)
+
+    # ----------------------------------------------------------- back-compat
+    @property
+    def batcher(self) -> BucketQueue:
+        """Round-6 name for the request queue (healthz / CLI used
+        ``service.batcher.depth``)."""
+        return self.queue
+
+    # ------------------------------------------------------------ front door
+    def bucket_for(self, shape: Tuple[int, int, int]) -> Tuple[int, int]:
+        """The padded (Hp, Wp) this image shape dispatches at."""
+        return self.policy.bucket_for(shape[0], shape[1])[:2]
+
+    def submit(self, left: np.ndarray, right: np.ndarray,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one stereo pair; returns a Future of ``ServeResult``.
+
+        Raises ``Overloaded`` at the door when the queue is full or the
+        engine is draining; the Future fails with ``DeadlineExceeded`` if
+        the request's deadline passes before a device picks it up.
+        """
+        t_admit = time.perf_counter()
+        left, right = np.asarray(left), np.asarray(right)
+        if left.ndim != 3 or left.shape != right.shape:
+            raise ValueError(
+                f"need two same-shape (H, W, 3) images, got {left.shape} "
+                f"vs {right.shape}")
+        hp, wp, grid = self.policy.bucket_for(left.shape[0], left.shape[1])
+        padder = InputPadder((1,) + left.shape, divis_by=grid)
+        l, r, t, b = padder.pads
+        spec = ((t, b), (l, r), (0, 0))
+        payload = _Payload(left=np.pad(left, spec, mode="edge"),
+                           right=np.pad(right, spec, mode="edge"),
+                           padder=padder)
+        now = time.monotonic()
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else self.serve_cfg.default_deadline_ms)
+        req = Request(bucket=(hp, wp), payload=payload,
+                      future=Future(), t_enqueue=now,
+                      deadline=(None if deadline_ms is None
+                                else now + deadline_ms / 1e3))
+        # Sampled request: root span + admission (validate/pad) span; the
+        # queue span opens here and closes at worker pickup (_run_chunk)
+        # or in the done-callback for requests dropped in the queue.
+        trace = self.tracer.start_trace(
+            "serve.request", bucket=str(req.bucket),
+            deadline_ms=deadline_ms)
+        if trace is not None:
+            req.trace = trace
+            self.tracer.add_span("serve.admission", trace,
+                                 t_admit, time.perf_counter(),
+                                 bucket=str(req.bucket))
+            req.queue_span = self.tracer.start_span("serve.queue", trace)
+            req.future.add_done_callback(
+                lambda f, r=req: self._finish_request_trace(r, f))
+        try:
+            self.queue.submit(req)     # raises Overloaded at the door
+        except Overloaded:
+            if trace is not None and trace.root is not None:
+                trace.root.set_attr("status", "overloaded")
+                self._finish_request_trace(req, None)
+            raise
+        return req.future
+
+    def _finish_request_trace(self, req: Request, future) -> None:
+        """Close the queue span (if no worker picked the request up) and
+        the root span; idempotence guards the two close paths (worker
+        pickup vs future resolution)."""
+        qs = req.queue_span
+        if qs is not None and qs.t_end is None:
+            self.tracer.finish(qs)
+        root = req.trace.root if req.trace is not None else None
+        if root is not None and root.t_end is None:
+            if future is not None:
+                exc = future.exception()
+                root.set_attr("status",
+                              "ok" if exc is None else type(exc).__name__)
+            self.tracer.finish(root)
+
+    def infer(self, left: np.ndarray, right: np.ndarray,
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> ServeResult:
+        """Blocking convenience: submit + wait (the in-process client)."""
+        return self.submit(left, right, deadline_ms).result(timeout=timeout)
+
+    # --------------------------------------------------------- compile cache
+    def _cost_key(self, bucket: Tuple[int, int], batch: int) -> str:
+        """Stable label of one compile point in the cost registry — what
+        GET /debug/compiles lists and the MFU path looks up."""
+        return f"serving.forward({bucket[0]}x{bucket[1]},b{batch})"
+
+    def compiled_cost(self, bucket: Tuple[int, int], batch: int = 1):
+        """The cost record for a compiled (bucket, batch) executable, or
+        None (no registry / not compiled yet / analysis degraded)."""
+        if self.costs is None:
+            return None
+        return self.costs.get(self._cost_key(bucket, batch))
+
+    def _forward_for(self, bucket: Tuple[int, int], batch: int = 1,
+                     worker: int = 0):
+        """The compiled batch-``batch`` executable for ``bucket`` on
+        ``worker``'s device — the engine-owned cache the round-6 design
+        spread across per-worker InferenceRunners.  Bounded per worker at
+        ``max_cached_shapes`` (bucket, batch) entries, oldest evicted."""
+        key = (worker, tuple(bucket), batch)
+        with self._cache_lock:
+            if key in self._compiled:
+                self._compiled[key] = self._compiled.pop(key)  # LRU refresh
+                return self._compiled[key]
+        # Build + (with cost telemetry) AOT-instrument outside the lock —
+        # distinct keys may compile concurrently on different workers.
+        fwd = make_forward(self.model, self.serve_cfg.iters,
+                           self._fetch_jax_dtype(),
+                           donate_images=self.serve_cfg.donate_buffers)
+        if self.costs is not None:
+            fwd = self.costs.instrument(
+                fwd, key=self._cost_key(bucket, batch), site="serving")
+        with self._cache_lock:
+            mine = [k for k in self._compiled if k[0] == worker]
+            while len(mine) >= self.serve_cfg.max_cached_shapes:
+                evicted = mine.pop(0)
+                self._compiled.pop(evicted)
+                log.info(
+                    "engine compile cache full (max_cached_shapes=%d): "
+                    "evicting oldest executable for bucket %s batch %d on "
+                    "worker %d — its next use re-pays XLA compile time",
+                    self.serve_cfg.max_cached_shapes, evicted[1],
+                    evicted[2], evicted[0])
+                if self.costs is not None:
+                    self.costs.note_runner_eviction(
+                        self._cost_key(evicted[1], evicted[2]), len(mine))
+            self._compiled[key] = fwd
+            if self.costs is not None:
+                self.costs.note_runner_cache_size(len(self._compiled))
+        return fwd
+
+    def _fetch_jax_dtype(self):
+        import jax.numpy as jnp
+
+        fetch = self.serve_cfg.fetch_dtype
+        if fetch not in (None, "fp16", "bf16"):
+            raise ValueError(f"fetch_dtype={fetch!r}: use 'fp16', 'bf16', "
+                             f"or None (full fp32 fetch)")
+        return {None: None, "fp16": jnp.float16,
+                "bf16": jnp.bfloat16}[fetch]
+
+    def prewarm(self, raw_hw: Tuple[int, int],
+                batch_sizes: Optional[Sequence[int]] = None) -> None:
+        """Compile + warm the whole bucket ladder for one raw shape on
+        every worker: each configured batch size dispatches once with
+        zero images, so the first real requests at this shape hit warm
+        executables (and, with cost telemetry, the registry holds every
+        ladder rung's cost record at boot)."""
+        import jax
+
+        h, w = int(raw_hw[0]), int(raw_hw[1])
+        hp, wp, _ = self.policy.bucket_for(h, w)
+        sizes = tuple(batch_sizes) if batch_sizes else self.queue.sizes
+        for widx, dev in enumerate(self.devices):
+            for n in sizes:
+                fwd = self._forward_for((hp, wp), n, worker=widx)
+                zeros = np.zeros((n, hp, wp, 3), np.uint8)
+                out = fwd(self._worker_vars[widx],
+                          jax.device_put(zeros, dev),
+                          jax.device_put(zeros.copy(), dev))
+                jax.block_until_ready(out)
+        log.info("prewarmed bucket %dx%d batch sizes %s on %d worker(s)",
+                 hp, wp, sizes, len(self.devices))
+
+    # --------------------------------------------------------------- workers
+    def _worker_loop(self, widx: int) -> None:
+        while True:
+            batch = self.queue.pop()
+            if batch is None:       # queue closed: worker shutdown
+                return
+            try:
+                self._run_batch(widx, batch)
+            except BaseException as e:  # noqa: BLE001 — fail the batch, not
+                self.metrics.failed.inc(len(batch))       # the worker thread
+                log.exception("batch of %d failed", len(batch))
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            finally:
+                self.metrics.inflight.dec(len(batch))
+
+    def _run_batch(self, widx: int, batch: List[Request]) -> None:
+        """One popped batch.  The scheduler pops exact bucket sizes, but
+        deadline triage can shrink a batch below the size it picked —
+        decompose so every device dispatch still runs a compiled
+        batch-size bucket."""
+        i = 0
+        for k in decompose_batch(len(batch), self.queue.sizes):
+            self._run_chunk(widx, batch[i:i + k])
+            i += k
+
+    def _run_chunk(self, widx: int, batch: List[Request]) -> None:
+        import jax
+
+        device = self.devices[widx]
+        t_pickup = time.monotonic()
+        waits = [t_pickup - r.t_enqueue for r in batch]
+        bucket = batch[0].bucket
+        n = len(batch)
+
+        # Sampled requests: the queue leg ends at worker pickup; the
+        # dispatch/fetch spans below share the chunk's time window but land
+        # in each request's own trace (a trace stays self-contained).
+        sampled = [r for r in batch if r.trace is not None]
+        p_pickup = time.perf_counter() if sampled else 0.0
+        for r in sampled:
+            if r.queue_span is not None and r.queue_span.t_end is None:
+                r.queue_span.set_attr("batch_size", n)
+                self.tracer.finish(r.queue_span)
+
+        with profiling.annotate("serve.device"):
+            # ONE batch-n dispatch through the (bucket, n) executable.
+            # n == 1 is the identical program the solo InferenceRunner
+            # compiles (make_forward), so that bucket stays bitwise-equal
+            # to solo inference; n > 1 amortizes the fixed per-dispatch
+            # work across a real batch axis with zero filler frames.
+            fwd = self._forward_for(bucket, n, worker=widx)
+            p1 = np.stack([r.payload.left for r in batch])
+            p2 = np.stack([r.payload.right for r in batch])
+            out = fwd(self._worker_vars[widx],
+                      jax.device_put(p1, device),
+                      jax.device_put(p2, device))
+            # Advisory device clock: honest on a local backend; behind an
+            # async tunnel readiness reports at dispatch (profiling.py) and
+            # only the fetch below is a real stop clock.
+            jax.block_until_ready(out)
+        t_ready = time.monotonic()
+        p_ready = time.perf_counter() if sampled else 0.0
+
+        with profiling.annotate("serve.fetch"):
+            flows_padded = np.asarray(out)        # (n, Hp, Wp)
+        t_fetched = time.monotonic()
+        p_fetched = time.perf_counter() if sampled else 0.0
+        for r in sampled:
+            self.tracer.add_span(
+                "serve.dispatch", r.trace, p_pickup, p_ready,
+                bucket=str(bucket), batch_size=n, device=str(device))
+            self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
+                                 batch_size=n)
+
+        device_s = t_ready - t_pickup
+        fetch_s = t_fetched - t_ready
+        self.metrics.observe_dispatch(n)
+        self.metrics.device_time.observe(device_s)
+        self.metrics.fetch_time.observe(fetch_s)
+        # Padding-waste accounting + the policy feedback loop: every
+        # dispatched pixel beyond the requests' real image pixels is pure
+        # waste at fixed GRU depth.  With the engine's exact-occupancy
+        # batch axis the only waste left is spatial padding — which is
+        # exactly what BucketPolicy.note adapts on.
+        real_px = sum(r.payload.padder.ht * r.payload.padder.wd
+                      for r in batch)
+        dispatched_px = n * bucket[0] * bucket[1]
+        self.metrics.observe_padding(bucket, real_px, dispatched_px)
+        self.policy.note(bucket, real_px, dispatched_px)
+        # MFU numerator: the batch-n executable's model flops, once per
+        # dispatch.
+        if self._mfu is not None:
+            rec = self.compiled_cost(bucket, batch=n)
+            if rec is not None and rec.flops:
+                self.metrics.dispatched_flops.inc(rec.flops)
+                self._mfu.note(rec.flops)
+        self.metrics.note_batch_done()
+        for r, fp, wait in zip(batch, flows_padded, waits):
+            exemplar = r.trace.trace_id if r.trace is not None else None
+            p_respond = time.perf_counter() if exemplar is not None else 0.0
+            flow = r.payload.padder.unpad(fp[None])[0]
+            if flow.dtype != np.float32:             # half-precision fetch
+                flow = flow.astype(np.float32)
+            total = t_fetched - r.t_enqueue
+            self.metrics.queue_wait.observe(wait, exemplar=exemplar)
+            self.metrics.total_latency.observe(total, exemplar=exemplar)
+            self.metrics.completed.inc()
+            r.future.set_result(ServeResult(
+                flow=np.ascontiguousarray(flow), queue_wait_s=wait,
+                device_s=device_s, fetch_s=fetch_s, total_s=total,
+                batch_size=n))
+            if exemplar is not None:
+                self.tracer.add_span("serve.respond", r.trace, p_respond,
+                                     time.perf_counter())
+
+    # -------------------------------------------------------------- shutdown
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful SIGTERM story: refuse new work (``Overloaded``), let
+        the workers finish the queue and in-flight batches, stop them.
+        Returns False if ``timeout`` elapsed first (workers are still
+        stopped; any stranded requests fail rather than hang)."""
+        t0 = time.monotonic()
+        ok = self.queue.drain(timeout=timeout)
+        remaining = (None if timeout is None
+                     else max(0.0, timeout - (time.monotonic() - t0)))
+        deadline = None if remaining is None else time.monotonic() + remaining
+        while self.metrics.inflight.value > 0:
+            if deadline is not None and time.monotonic() > deadline:
+                ok = False
+                break
+            time.sleep(0.002)
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        """Hard stop: closes the queue (queued requests fail with
+        ``Overloaded``; blocked worker pops return None) and joins the
+        worker threads.  ``drain`` first for the graceful version."""
+        if self._closed:
+            return
+        self._closed = True
+        self.queue.close()
+        for t in self._workers:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# The engine IS the service: the round-6 class name stays importable for
+# every existing call site (serving/http.py, cli/serve.py, tests).
+StereoService = ServingEngine

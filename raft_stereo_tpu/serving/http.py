@@ -1,4 +1,4 @@
-"""Localhost HTTP front end over ``StereoService`` — stdlib only.
+"""Localhost HTTP front end over the serving engine — stdlib only.
 
 Endpoints:
 
@@ -132,9 +132,9 @@ def make_handler(service: StereoService,
                             "text/plain; version=0.0.4")
             elif path == "/healthz":
                 self._reply_json(200, {
-                    "status": ("draining" if service.batcher.draining
+                    "status": ("draining" if service.queue.draining
                                else "ok"),
-                    "queue_depth": service.batcher.depth,
+                    "queue_depth": service.queue.depth,
                     "inflight": service.metrics.inflight.value,
                     "last_batch_age_s":
                         service.metrics.last_batch_age_s(),

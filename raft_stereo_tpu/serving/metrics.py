@@ -82,6 +82,16 @@ class ServingMetrics:
         self.anomalies = r.counter(
             "serve_anomalies_total",
             "anomalies detected (queue saturation, deadline-miss rate)")
+        # Engine dispatch accounting: serve_batches_total counts device
+        # dispatches (the "fewer dispatches than requests" batching win is
+        # completed/batches), and the per-batch-size family shows which
+        # bucket ladder rungs traffic actually exercises.
+        self._dispatch_lock = threading.Lock()
+        self._dispatch_by_size: Dict[int, Counter] = {}
+        self.bucket_refinements = r.counter(
+            "serve_bucket_refinements_total",
+            "spatial buckets refined to a finer pad grid by the measured "
+            "padding-waste feedback loop (adaptive_buckets)")
         # Padding-waste accounting (telemetry/costs.py motivates it): the
         # device runs padded shapes, so wasted pixels are wasted flops in
         # exact proportion — the /32 spatial pad plus stack mode's pow2
@@ -113,6 +123,28 @@ class ServingMetrics:
         self._age_lock = threading.Lock()
         self._last_batch_mono: Optional[float] = None
 
+    def observe_dispatch(self, batch_size: int) -> None:
+        """Record one device dispatch at ``batch_size`` occupancy: the
+        batches counter, the occupancy histogram, and the per-size
+        ``serve_dispatches_total{batch="N"}`` counter family."""
+        self.batches.inc()
+        self.batch_occupancy.observe(batch_size)
+        with self._dispatch_lock:
+            c = self._dispatch_by_size.get(batch_size)
+            if c is None:
+                c = self.registry.counter(
+                    "serve_dispatches_total",
+                    "device dispatches by batch-size bucket",
+                    labels={"batch": str(batch_size)})
+                self._dispatch_by_size[batch_size] = c
+        c.inc()
+
+    def dispatches_at(self, batch_size: int) -> int:
+        """Dispatch count for one batch-size bucket (0 if never used)."""
+        with self._dispatch_lock:
+            c = self._dispatch_by_size.get(batch_size)
+        return 0 if c is None else c.value
+
     def observe_padding(self, bucket: Tuple[int, int], real_pixels: int,
                         dispatched_pixels: int) -> None:
         """Record one dispatch's pixel accounting: ``real_pixels`` the sum
@@ -140,6 +172,15 @@ class ServingMetrics:
                 self._bucket_px[label] = pair
         pair[0].inc(real_pixels)
         pair[1].inc(waste)
+
+    def bucket_pixels(self) -> Dict[str, Dict[str, int]]:
+        """Per-bucket pixel accounting snapshot: ``{"HxW": {"real_px": n,
+        "pad_px": n}}`` — what bench_serve.py publishes next to the MFU
+        numbers and what the waste feedback loop acts on."""
+        with self._bucket_lock:
+            return {label: {"real_px": pair[0].value,
+                            "pad_px": pair[1].value}
+                    for label, pair in self._bucket_px.items()}
 
     def note_batch_done(self) -> None:
         """Stamp micro-batch completion — the freshness signal behind

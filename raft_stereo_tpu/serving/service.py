@@ -1,458 +1,15 @@
-"""Concurrent stereo-depth service: batcher front door + device worker pool.
+"""Compatibility surface for the round-6 serving API.
 
-Turns the single-image ``eval.runner.InferenceRunner`` into a
-traffic-handling subsystem.  Requests enter through ``submit`` (or the
-blocking ``infer``), are grouped by /32-padded shape bucket in the
-``MicroBatcher``, and micro-batches run on a pool of device workers — one
-per local device for data-parallel dispatch — each owning an
-``InferenceRunner`` whose bounded per-(shape, batch) compile cache this
-service inherits unchanged.
-
-Two batch execution modes, because they trade differently:
-
-* ``"chain"`` (default) — every image in the micro-batch runs through the
-  SAME compiled batch-1 executable the solo ``InferenceRunner.__call__``
-  uses; the N forwards are dispatched back-to-back (JAX async dispatch
-  pipelines them) and synced once at the batch fetch.  One executable per
-  padded shape regardless of batch size, and results are **bitwise equal**
-  to a solo run of the same image (tests/test_serving.py asserts it) —
-  batching amortizes the per-image host sync + Python overhead without
-  touching numerics.
-* ``"stack"`` — the micro-batch is stacked into ONE batched dispatch,
-  batch-padded to the next power of two (at most log2(max_batch)+1
-  executables per shape).  Maximum amortization of per-dispatch overhead —
-  the right mode behind a high-RTT device tunnel — but a batch-N
-  executable reassociates differently from batch-1 (~1e-5 drift, the
-  documented run_batch trade; tests/test_cli.py).
-
-Shutdown mirrors the train loop's preemption story (training/train_loop.py):
-``drain()`` refuses new work with the typed ``Overloaded``, flushes the
-queue, finishes in-flight batches, and only then stops the workers.
+Round 11 replaced the ``StereoService`` + ``MicroBatcher`` + per-worker
+``InferenceRunner`` split with the unified batch-N serving engine
+(serving/engine.py): one object owning the compile cache (true batch-N
+bucket executables with buffer donation), the continuous-batching
+scheduler, and the cost/padding-waste telemetry loop.  ``StereoService``
+is now an alias of ``ServingEngine`` and every import from this module
+keeps working; see the engine module for the design.
 """
 
-from __future__ import annotations
+from raft_stereo_tpu.serving.engine import (  # noqa: F401 — re-exports
+    ServeConfig, ServeResult, ServingEngine, StereoService)
 
-import dataclasses
-import logging
-import queue
-import threading
-import time
-from concurrent.futures import Future
-from typing import Dict, List, Optional, Sequence, Tuple
-
-import numpy as np
-
-from raft_stereo_tpu import profiling
-from raft_stereo_tpu.config import RaftStereoConfig
-from raft_stereo_tpu.eval.runner import InferenceRunner
-from raft_stereo_tpu.ops.padding import InputPadder
-from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
-                                             Overloaded, Request)
-from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
-
-log = logging.getLogger(__name__)
-
-BATCH_MODES = ("chain", "stack")
-
-
-@dataclasses.dataclass(frozen=True)
-class ServeConfig:
-    """Serving knobs (model architecture stays in RaftStereoConfig)."""
-
-    max_batch: int = 8           # flush a bucket at this many requests
-    max_wait_ms: float = 5.0     # ... or when its oldest waited this long
-    max_queue: int = 64          # admission bound; beyond it -> Overloaded
-    batch_mode: str = "chain"    # see module docstring
-    data_parallel: int = 1       # device workers (<= local device count)
-    iters: int = 32              # GRU iterations per request
-    shape_bucket: Optional[int] = None   # coarser-than-/32 padding grid
-    max_cached_shapes: int = 16  # per-worker compile cache bound
-    fetch_dtype: Optional[str] = None    # "fp16" | "bf16" half fetch
-    default_deadline_ms: Optional[float] = None  # per-request override wins
-    # Fraction of requests whose span tree is recorded (telemetry/spans.py:
-    # admission -> queue -> dispatch -> fetch -> respond, exported as
-    # Chrome trace JSON via GET /debug/spans).  0.0 (default) disables
-    # tracing entirely — every span site takes the constant-time None exit.
-    trace_sample_rate: float = 0.0
-    # Compile-cost telemetry (telemetry/costs.py): route every worker
-    # compile through the AOT path so GET /debug/compiles lists each
-    # bucket executable's flops/bytes/memory and the MFU gauges get their
-    # flops numerator.  False (default) keeps the workers' exact jax.jit
-    # dispatch — zero new code on the request path.
-    cost_telemetry: bool = False
-    # MFU denominator override (TFLOP/s); None = the auto table keyed by
-    # the local device kind (costs.DEVICE_PEAK_TFLOPS).
-    device_peak_tflops: Optional[float] = None
-
-    def __post_init__(self):
-        if self.batch_mode not in BATCH_MODES:
-            raise ValueError(
-                f"batch_mode={self.batch_mode!r} not in {BATCH_MODES}")
-        if self.data_parallel < 1:
-            raise ValueError(f"data_parallel={self.data_parallel} must be "
-                             f">= 1")
-        if not 0.0 <= self.trace_sample_rate <= 1.0:
-            raise ValueError(f"trace_sample_rate={self.trace_sample_rate} "
-                             f"must be in [0, 1]")
-
-
-@dataclasses.dataclass
-class ServeResult:
-    """One answered request: the flow plus its latency decomposition."""
-
-    flow: np.ndarray             # (H, W) x-flow (= -disparity), float32
-    queue_wait_s: float          # admission -> worker pickup
-    device_s: float              # dispatch -> outputs ready (advisory
-    #                              behind an async tunnel; see metrics.py)
-    fetch_s: float               # device->host result transfer
-    total_s: float               # admission -> result ready
-    batch_size: int              # occupancy of the micro-batch it rode in
-
-    @property
-    def disparity(self) -> np.ndarray:
-        """Positive disparity (the user-facing convention, cli/demo.py)."""
-        return -self.flow
-
-
-@dataclasses.dataclass
-class _Payload:
-    """What the service parks in Request.payload: padded inputs + unpadder."""
-
-    left: np.ndarray             # (Hp, Wp, 3) host-padded
-    right: np.ndarray
-    padder: InputPadder
-
-
-_STOP = object()
-
-
-class StereoService:
-    """The concurrent front door over ``InferenceRunner``.
-
-    ``devices`` defaults to the first ``serve_cfg.data_parallel`` local JAX
-    devices; each gets a worker thread with the variables resident on that
-    device, so same-bucket micro-batches dispatch data-parallel across the
-    pool.
-    """
-
-    def __init__(self, config: RaftStereoConfig, variables,
-                 serve_cfg: ServeConfig = ServeConfig(),
-                 devices: Optional[Sequence] = None,
-                 registry: Optional[MetricsRegistry] = None,
-                 tracer=None):
-        import jax
-
-        from raft_stereo_tpu.telemetry.spans import SpanTracer
-
-        self.serve_cfg = serve_cfg
-        # Request-path span tracer (telemetry/spans.py).  At the default
-        # sample rate 0.0 every start_trace returns None and the span
-        # plumbing below is a handful of no-op attribute checks per
-        # request — serving numerics and dispatch behavior are untouched.
-        self.tracer = (tracer if tracer is not None
-                       else SpanTracer(serve_cfg.trace_sample_rate))
-        if devices is None:
-            local = jax.local_devices()
-            if serve_cfg.data_parallel > len(local):
-                raise ValueError(
-                    f"data_parallel={serve_cfg.data_parallel} exceeds the "
-                    f"{len(local)} local devices")
-            devices = local[:serve_cfg.data_parallel]
-        self.devices = list(devices)
-        self.metrics = ServingMetrics(registry,
-                                      max_batch=serve_cfg.max_batch)
-        # Compile-cost registry (telemetry/costs.py): one per service,
-        # shared by all workers — same bucket => same executable => one
-        # cost record.  None (default) leaves the runners' jit dispatch
-        # untouched.
-        self.costs = None
-        self._mfu = None
-        if serve_cfg.cost_telemetry:
-            from raft_stereo_tpu.telemetry.costs import (CompileRegistry,
-                                                         MfuMeter)
-            self.costs = CompileRegistry(
-                registry=self.metrics.registry,
-                device_peak_tflops=serve_cfg.device_peak_tflops)
-            self._mfu = MfuMeter(
-                self.metrics.mfu, self.costs.peak_flops,
-                achieved_gauge=self.metrics.achieved_flops_per_s)
-        # Per-worker runner: variables live on that worker's device, and the
-        # bounded per-(padded shape, batch) compile cache is per worker.
-        self._runners: List[InferenceRunner] = []
-        for dev in self.devices:
-            self._runners.append(InferenceRunner(
-                config, jax.device_put(variables, dev),
-                iters=serve_cfg.iters, shape_bucket=serve_cfg.shape_bucket,
-                max_cached_shapes=serve_cfg.max_cached_shapes,
-                fetch_dtype=serve_cfg.fetch_dtype,
-                cost_registry=self.costs, cost_site="serving"))
-        self.config = self._runners[0].config
-        self._divis = self._runners[0].divis_by
-        # Handoff between the batcher's flush thread and the workers: small
-        # and bounded so a saturated pool stalls flushing (the backpressure
-        # path) instead of accumulating dispatched-but-unstarted batches.
-        self._work: "queue.Queue" = queue.Queue(maxsize=len(self.devices))
-        self._workers = [
-            threading.Thread(target=self._worker_loop, args=(r, d),
-                             daemon=True, name=f"stereo-worker-{i}")
-            for i, (r, d) in enumerate(zip(self._runners, self.devices))]
-        for t in self._workers:
-            t.start()
-        self.batcher = MicroBatcher(
-            dispatch=self._dispatch, max_batch=serve_cfg.max_batch,
-            max_wait_ms=serve_cfg.max_wait_ms, max_queue=serve_cfg.max_queue,
-            metrics=self.metrics)
-        self._closed = False
-
-    # ------------------------------------------------------------ front door
-    def bucket_for(self, shape: Tuple[int, int, int]) -> Tuple[int, int]:
-        """The padded (Hp, Wp) this image shape dispatches at."""
-        padder = InputPadder((1,) + tuple(shape), divis_by=self._divis)
-        l, r, t, b = padder.pads
-        return (shape[0] + t + b, shape[1] + l + r)
-
-    def submit(self, left: np.ndarray, right: np.ndarray,
-               deadline_ms: Optional[float] = None) -> Future:
-        """Admit one stereo pair; returns a Future of ``ServeResult``.
-
-        Raises ``Overloaded`` at the door when the queue is full or the
-        service is draining; the Future fails with ``DeadlineExceeded`` if
-        the request's deadline passes before a device picks it up.
-        """
-        t_admit = time.perf_counter()
-        left, right = np.asarray(left), np.asarray(right)
-        if left.ndim != 3 or left.shape != right.shape:
-            raise ValueError(
-                f"need two same-shape (H, W, 3) images, got {left.shape} "
-                f"vs {right.shape}")
-        padder = InputPadder((1,) + left.shape, divis_by=self._divis)
-        l, r, t, b = padder.pads
-        spec = ((t, b), (l, r), (0, 0))
-        payload = _Payload(left=np.pad(left, spec, mode="edge"),
-                           right=np.pad(right, spec, mode="edge"),
-                           padder=padder)
-        now = time.monotonic()
-        deadline_ms = (deadline_ms if deadline_ms is not None
-                       else self.serve_cfg.default_deadline_ms)
-        req = Request(bucket=payload.left.shape[:2], payload=payload,
-                      future=Future(), t_enqueue=now,
-                      deadline=(None if deadline_ms is None
-                                else now + deadline_ms / 1e3))
-        # Sampled request: root span + admission (validate/pad) span; the
-        # queue span opens here and closes at worker pickup (_run_batch) or
-        # in the done-callback for requests dropped in the queue.
-        trace = self.tracer.start_trace(
-            "serve.request", bucket=str(req.bucket),
-            deadline_ms=deadline_ms)
-        if trace is not None:
-            req.trace = trace
-            self.tracer.add_span("serve.admission", trace,
-                                 t_admit, time.perf_counter(),
-                                 bucket=str(req.bucket))
-            req.queue_span = self.tracer.start_span("serve.queue", trace)
-            req.future.add_done_callback(
-                lambda f, r=req: self._finish_request_trace(r, f))
-        try:
-            self.batcher.submit(req)   # raises Overloaded at the door
-        except Overloaded:
-            if trace is not None and trace.root is not None:
-                trace.root.set_attr("status", "overloaded")
-                self._finish_request_trace(req, None)
-            raise
-        return req.future
-
-    def _finish_request_trace(self, req: Request, future) -> None:
-        """Close the queue span (if the worker never picked the request
-        up) and the root span; idempotence guards the two close paths
-        (worker pickup vs future resolution)."""
-        qs = req.queue_span
-        if qs is not None and qs.t_end is None:
-            self.tracer.finish(qs)
-        root = req.trace.root if req.trace is not None else None
-        if root is not None and root.t_end is None:
-            if future is not None:
-                exc = future.exception()
-                root.set_attr("status",
-                              "ok" if exc is None else type(exc).__name__)
-            self.tracer.finish(root)
-
-    def infer(self, left: np.ndarray, right: np.ndarray,
-              deadline_ms: Optional[float] = None,
-              timeout: Optional[float] = None) -> ServeResult:
-        """Blocking convenience: submit + wait (the in-process client)."""
-        return self.submit(left, right, deadline_ms).result(timeout=timeout)
-
-    # --------------------------------------------------------------- workers
-    def _dispatch(self, batch: List[Request]) -> None:
-        """Batcher flush -> worker pool handoff.  Inflight is counted from
-        HERE (not worker pickup) so ``drain``'s inflight==0 check covers
-        batches parked in the handoff queue; the bounded ``put`` is the
-        backpressure stall when the pool is saturated."""
-        self.metrics.inflight.inc(len(batch))
-        self._work.put(batch)
-
-    def _worker_loop(self, runner: InferenceRunner, device) -> None:
-        while True:
-            batch = self._work.get()
-            if batch is _STOP:
-                return
-            try:
-                self._run_batch(runner, device, batch)
-            except BaseException as e:  # noqa: BLE001 — fail the batch, not
-                self.metrics.failed.inc(len(batch))       # the worker thread
-                log.exception("micro-batch of %d failed", len(batch))
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-            finally:
-                self.metrics.inflight.dec(len(batch))
-
-    def _run_batch(self, runner: InferenceRunner, device,
-                   batch: List[Request]) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        t_pickup = time.monotonic()
-        waits = [t_pickup - r.t_enqueue for r in batch]
-        bucket = batch[0].bucket
-        n = len(batch)
-
-        # Sampled requests: the queue leg ends at worker pickup; the
-        # dispatch/fetch spans below share the batch's time window but land
-        # in each request's own trace (a trace stays self-contained).
-        sampled = [r for r in batch if r.trace is not None]
-        p_pickup = time.perf_counter() if sampled else 0.0
-        for r in sampled:
-            if r.queue_span is not None and r.queue_span.t_end is None:
-                r.queue_span.set_attr("batch_size", n)
-                self.tracer.finish(r.queue_span)
-
-        with profiling.annotate("serve.device"):
-            if self.serve_cfg.batch_mode == "chain":
-                # N batch-1 dispatches through the one per-shape executable
-                # (bitwise-identical to solo InferenceRunner), pipelined by
-                # async dispatch, synced once below.
-                exec_batch, frames = 1, n
-                fwd = runner._forward_for(bucket, batch=1)
-                outs = [fwd(runner.variables,
-                            jax.device_put(r.payload.left[None], device),
-                            jax.device_put(r.payload.right[None], device))
-                        for r in batch]
-            else:
-                # "stack": one batched dispatch.  The batch axis pads to the
-                # next power of two (not to max_batch): compiles per shape
-                # stay bounded at log2(max_batch)+1 executables while a
-                # half-full flush wastes at most ~2x filler compute instead
-                # of always paying the full max_batch forward.
-                nb = 1 << (n - 1).bit_length()
-                exec_batch, frames = nb, nb
-                p1 = np.stack([r.payload.left for r in batch]
-                              + [batch[-1].payload.left] * (nb - n))
-                p2 = np.stack([r.payload.right for r in batch]
-                              + [batch[-1].payload.right] * (nb - n))
-                fwd = runner._forward_for(bucket, batch=nb)
-                stacked = fwd(runner.variables,
-                              jax.device_put(p1, device),
-                              jax.device_put(p2, device))
-                outs = [stacked[i] for i in range(n)]
-            # Advisory device clock: honest on a local backend; behind an
-            # async tunnel readiness reports at dispatch (profiling.py) and
-            # only the fetch below is a real stop clock.
-            for o in outs:
-                jax.block_until_ready(o)
-        t_ready = time.monotonic()
-        p_ready = time.perf_counter() if sampled else 0.0
-
-        with profiling.annotate("serve.fetch"):
-            flows_padded = [np.asarray(o) for o in outs]
-        t_fetched = time.monotonic()
-        p_fetched = time.perf_counter() if sampled else 0.0
-        for r in sampled:
-            self.tracer.add_span(
-                "serve.dispatch", r.trace, p_pickup, p_ready,
-                bucket=str(bucket), batch_size=n, device=str(device),
-                mode=self.serve_cfg.batch_mode)
-            self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
-                                 batch_size=n)
-
-        device_s = t_ready - t_pickup
-        fetch_s = t_fetched - t_ready
-        self.metrics.batches.inc()
-        self.metrics.batch_occupancy.observe(n)
-        self.metrics.device_time.observe(device_s)
-        self.metrics.fetch_time.observe(fetch_s)
-        # Padding-waste accounting: every dispatched pixel beyond the
-        # requests' real image pixels — the /32 spatial pad plus stack
-        # mode's pow2 batch fill — is pure waste at fixed GRU depth.
-        real_px = sum(r.payload.padder.ht * r.payload.padder.wd
-                      for r in batch)
-        self.metrics.observe_padding(bucket, real_px,
-                                     frames * bucket[0] * bucket[1])
-        # MFU numerator: the compiled executable's model flops times the
-        # dispatches this batch issued (chain: n batch-1 programs; stack:
-        # one batch-nb program).
-        if self._mfu is not None:
-            rec = runner.compiled_cost(bucket, batch=exec_batch)
-            if rec is not None and rec.flops:
-                flops = rec.flops * (n if exec_batch == 1 else 1)
-                self.metrics.dispatched_flops.inc(flops)
-                self._mfu.note(flops)
-        self.metrics.note_batch_done()
-        for r, fp, wait in zip(batch, flows_padded, waits):
-            exemplar = r.trace.trace_id if r.trace is not None else None
-            p_respond = time.perf_counter() if exemplar is not None else 0.0
-            fp = fp if fp.ndim == 3 else fp[None]        # stack mode: (Hp,Wp)
-            flow = r.payload.padder.unpad(fp)[0]
-            if flow.dtype != np.float32:                 # half-precision fetch
-                flow = flow.astype(np.float32)
-            total = t_fetched - r.t_enqueue
-            self.metrics.queue_wait.observe(wait, exemplar=exemplar)
-            self.metrics.total_latency.observe(total, exemplar=exemplar)
-            self.metrics.completed.inc()
-            r.future.set_result(ServeResult(
-                flow=np.ascontiguousarray(flow), queue_wait_s=wait,
-                device_s=device_s, fetch_s=fetch_s, total_s=total,
-                batch_size=n))
-            if exemplar is not None:
-                self.tracer.add_span("serve.respond", r.trace, p_respond,
-                                     time.perf_counter())
-
-    # -------------------------------------------------------------- shutdown
-    def drain(self, timeout: Optional[float] = None) -> bool:
-        """Graceful SIGTERM story: refuse new work (``Overloaded``), flush
-        the queue, finish in-flight batches, stop the workers.  Returns
-        False if ``timeout`` elapsed first (workers are still stopped; any
-        stranded requests fail rather than hang)."""
-        t0 = time.monotonic()
-        ok = self.batcher.drain(timeout=timeout)
-        # Wait for the work queue + in-flight batches to finish.
-        remaining = (None if timeout is None
-                     else max(0.0, timeout - (time.monotonic() - t0)))
-        deadline = None if remaining is None else time.monotonic() + remaining
-        while self.metrics.inflight.value > 0:
-            if deadline is not None and time.monotonic() > deadline:
-                ok = False
-                break
-            time.sleep(0.002)
-        self.close()
-        return ok
-
-    def close(self) -> None:
-        """Hard stop: ends the batcher (queued requests fail with
-        ``Overloaded``) and the worker threads.  ``drain`` first for the
-        graceful version."""
-        if self._closed:
-            return
-        self._closed = True
-        self.batcher.close()
-        for _ in self._workers:
-            self._work.put(_STOP)
-        for t in self._workers:
-            t.join(timeout=5.0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-        return False
+__all__ = ["ServeConfig", "ServeResult", "ServingEngine", "StereoService"]

@@ -153,9 +153,34 @@ class CompileRecord:
             return None
         return self.flops / self.bytes_accessed
 
+    @property
+    def donated_alias_bytes(self) -> Optional[int]:
+        """Bytes of output the executable writes into donated input
+        buffers (``memory_analysis.alias_size_in_bytes``) — the HBM the
+        donation actually saved.  0 means donation was declared but no
+        output matched a donated buffer's size; None when the analysis
+        degraded."""
+        if self.memory is None:
+            return None
+        return self.memory.get("alias_size_in_bytes")
+
+    @property
+    def hbm_bytes(self) -> Optional[int]:
+        """The executable's live HBM footprint: arguments + outputs +
+        temporaries, net of donated-input aliasing (aliased outputs reuse
+        argument memory instead of allocating their own)."""
+        if self.memory is None:
+            return None
+        total = sum(self.memory.get(f, 0)
+                    for f in ("argument_size_in_bytes",
+                              "output_size_in_bytes",
+                              "temp_size_in_bytes"))
+        return total - self.memory.get("alias_size_in_bytes", 0)
+
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
         d["arithmetic_intensity"] = self.arithmetic_intensity
+        d["hbm_bytes"] = self.hbm_bytes
         return d
 
 

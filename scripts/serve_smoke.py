@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""CI smoke: the batch-N serving engine at tiny shapes on CPU.
+
+The acceptance check for the engine wired end to end — continuous-batching
+scheduler + batch-N bucket executables + cost/waste telemetry — without
+datasets or an accelerator.  The headline assertion is the batching win
+itself: at batch-4 occupancy the engine issues FEWER device dispatches
+than it completes requests (the round-6 chain mode dispatched one program
+per request, so dispatches == requests).  Also asserts batch-4 results
+match solo ``InferenceRunner`` inference (within the documented batch-N
+reassociation tolerance; the batch-1 bucket's bitwise parity is pinned by
+the tier-1 tests) and that the cost registry holds a record per bucket
+ladder rung.
+
+Writes a ``bench_record`` JSON (default ``BENCH_SERVE_smoke.json``; set
+SERVE_SMOKE_OUT to pin the path — CI uploads it as an artifact).  Exit 0
+on success, non-zero with a diagnostic on any failed assertion.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+OUT = os.environ.get("SERVE_SMOKE_OUT",
+                     os.path.join(_REPO, "BENCH_SERVE_smoke.json"))
+
+
+def main() -> int:
+    from _hermetic import force_cpu
+
+    jax = force_cpu(1)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
+    cfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
+                           corr_backend="reg")
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    rng = np.random.default_rng(0)
+    hw = (48, 64)
+    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+             for _ in range(4)]
+    rights = [np.roll(l, -3, axis=1) for l in lefts]
+    solo = InferenceRunner(cfg, variables, iters=1)
+
+    rounds, k = 3, 4
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=4, iters=1, cost_telemetry=True)) as svc:
+        svc.prewarm(hw)
+        bucket = svc.bucket_for(hw + (3,))
+        d0, c0 = svc.metrics.batches.value, svc.metrics.completed.value
+        t0 = time.perf_counter()
+        for _ in range(rounds):      # staged batch-4 bursts
+            svc.queue.pause()
+            futs = [svc.submit(lefts[i], rights[i]) for i in range(k)]
+            svc.queue.resume()
+            results = [f.result(timeout=300) for f in futs]
+        wall = time.perf_counter() - t0
+        dispatches = svc.metrics.batches.value - d0
+        completed = svc.metrics.completed.value - c0
+
+        assert completed == rounds * k, (completed, rounds * k)
+        assert dispatches < completed, (
+            f"batch-4 occupancy must issue fewer dispatches than requests: "
+            f"{dispatches} dispatches for {completed} requests")
+        assert all(r.batch_size == k for r in results), \
+            [r.batch_size for r in results]
+        for i, r in enumerate(results):
+            # batch-N executables reassociate reductions (~1e-5 vs the
+            # batch-1 program, which alone is the bitwise-parity bucket)
+            flow, _ = solo(lefts[i], rights[i])
+            assert np.allclose(r.flow, flow, atol=5e-4), \
+                f"batch-{k} result {i} drifted beyond tolerance vs solo"
+        keys = sorted(rec.key for rec in svc.costs.records())
+        for n in svc.queue.sizes:        # one record per ladder rung
+            want = f"serving.forward({bucket[0]}x{bucket[1]},b{n})"
+            assert want in keys, (want, keys)
+        waste = svc.metrics.padding_waste
+        assert waste.count >= dispatches > 0
+
+        rec = bench_record({
+            "metric": "serve_smoke_req_per_dispatch",
+            "value": round(completed / dispatches, 2),
+            "unit": f"requests/dispatch (batch-{k} staged bursts, "
+                    f"{hw[0]}x{hw[1]}, iters=1, CPU)",
+            "platform": jax.devices()[0].platform,
+            "completed": completed,
+            "dispatches": dispatches,
+            "throughput_hz": round(completed / wall, 2),
+            "executables": keys,
+        })
+    print(json.dumps(rec))
+    write_record(OUT, rec, indent=1)
+    print(f"serve smoke OK -> {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -321,7 +321,7 @@ def test_cost_telemetry_off_keeps_plain_jit_dispatch(tiny_model):
     try:
         assert svc.costs is None and svc._mfu is None
         assert svc.metrics.registry.get("compiles_total") is None
-        fwd = svc._runners[0]._forward_for((32, 64))
+        fwd = svc._forward_for((32, 64), batch=1)
         from raft_stereo_tpu.telemetry.costs import _InstrumentedFn
         assert not isinstance(fwd, _InstrumentedFn)
     finally:

@@ -1,12 +1,16 @@
-"""Serving subsystem tests (tier-1, CPU).
+"""Serving-engine tests (tier-1, CPU).
 
-Batcher policy tests run against an injected dispatch callable — no JAX at
-all — so bucket grouping, timed flush, deadline triage, shedding, and drain
-are exercised in milliseconds.  Service-level tests run a REAL tiny model:
-the headline assertions are (a) a micro-batched response is **bitwise
-equal** to the same image run alone through ``InferenceRunner`` (chain
-mode's contract), and (b) a burst beyond capacity sheds with the typed
-``Overloaded`` while everything admitted still completes.
+Scheduler tests run against the bare ``BucketQueue`` — no JAX at all — so
+bucket grouping, batch-size selection, continuous (immediate) dispatch,
+deadline triage, shedding, and drain are exercised in milliseconds.
+Engine tests run a REAL tiny model: the headline assertions are (a) every
+batch-size bucket's response (1/2/4/8, and partial-occupancy
+decompositions) matches the same image run alone through
+``InferenceRunner`` — the batch-1 bucket **bitwise equal** (it compiles
+the identical program; the old chain mode's contract) and batch N within
+the documented ~1e-5 reassociation tolerance, (b) batching means FEWER
+device dispatches than completed requests, and (c) a burst beyond capacity
+sheds with the typed ``Overloaded`` while everything admitted completes.
 """
 
 import io
@@ -19,34 +23,17 @@ from concurrent.futures import Future
 import numpy as np
 import pytest
 
-from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
-                                             Overloaded, Request)
+from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
+                                             Overloaded, Request,
+                                             decompose_batch,
+                                             pick_batch_size)
+from raft_stereo_tpu.serving.engine import BucketPolicy
 from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
 
 # Pure-XLA backend: the serving tests assert bitwise properties and must
 # not depend on the Pallas kernels' CPU interpret path.
 TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
 ITERS = 1
-
-
-# --------------------------------------------------------------- batcher
-class _Collector:
-    """Dispatch sink recording batches; optionally blocks until released."""
-
-    def __init__(self, block: bool = False):
-        self.batches = []
-        self.event = threading.Event()
-        self._gate = threading.Event()
-        if not block:
-            self._gate.set()
-
-    def __call__(self, batch):
-        self._gate.wait()
-        self.batches.append(batch)
-        self.event.set()
-
-    def release(self):
-        self._gate.set()
 
 
 def _req(bucket=(64, 96), deadline_s=None):
@@ -56,124 +43,204 @@ def _req(bucket=(64, 96), deadline_s=None):
                    deadline=None if deadline_s is None else now + deadline_s)
 
 
-def test_batcher_flushes_full_bucket_immediately():
-    sink = _Collector()
-    b = MicroBatcher(sink, max_batch=3, max_wait_ms=10_000, max_queue=16)
-    try:
-        reqs = [_req() for _ in range(3)]
-        for r in reqs:
-            b.submit(r)
-        assert sink.event.wait(timeout=5.0), "full bucket must flush at once"
-        assert [len(x) for x in sink.batches] == [3]
-        assert sink.batches[0] == reqs  # FIFO order preserved
-    finally:
-        b.close()
+# ------------------------------------------------------- batch-size buckets
+def test_pick_batch_size_selects_largest_filled_bucket():
+    sizes = (1, 2, 4, 8)
+    assert [pick_batch_size(d, sizes) for d in range(1, 10)] == [
+        1, 2, 2, 4, 4, 4, 4, 8, 8]
+    # partial batches dispatch at the next size DOWN, never padded up
+    assert pick_batch_size(3, sizes) == 2
+    assert pick_batch_size(7, sizes) == 4
+    # capped ladders
+    assert pick_batch_size(9, (1, 2)) == 2
+    with pytest.raises(ValueError, match="include 1"):
+        pick_batch_size(1, (2, 4))
+    with pytest.raises(ValueError, match="depth"):
+        pick_batch_size(0, sizes)
 
 
-def test_batcher_groups_by_shape_bucket():
-    sink = _Collector()
-    b = MicroBatcher(sink, max_batch=2, max_wait_ms=10_000, max_queue=16)
-    try:
-        a1, a2 = _req(bucket=(64, 96)), _req(bucket=(64, 96))
-        c1, c2 = _req(bucket=(96, 128)), _req(bucket=(96, 128))
-        for r in (a1, c1, a2, c2):  # interleaved submission
-            b.submit(r)
-        deadline = time.monotonic() + 5.0
-        while len(sink.batches) < 2 and time.monotonic() < deadline:
-            time.sleep(0.005)
-        assert sorted(tuple(r.bucket for r in batch)
-                      for batch in sink.batches) == [
-            ((64, 96), (64, 96)), ((96, 128), (96, 128))]
-    finally:
-        b.close()
+def test_decompose_batch_greedy_no_filler():
+    sizes = (1, 2, 4, 8)
+    assert decompose_batch(7, sizes) == [4, 2, 1]
+    assert decompose_batch(8, sizes) == [8]
+    assert decompose_batch(3, sizes) == [2, 1]
+    assert decompose_batch(5, (1, 2)) == [2, 2, 1]
+    assert sum(decompose_batch(13, sizes)) == 13
 
 
-def test_batcher_max_wait_flushes_partial_bucket():
-    sink = _Collector()
-    b = MicroBatcher(sink, max_batch=8, max_wait_ms=30, max_queue=16)
-    try:
+# ----------------------------------------------------------------- scheduler
+def test_queue_pop_selects_batch_size_from_depth():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    reqs = [_req() for _ in range(7)]
+    for r in reqs:
+        q.submit(r)
+    # depth 7 -> 4, then 2, then 1; FIFO order preserved throughout
+    batches = [q.pop(timeout=5), q.pop(timeout=5), q.pop(timeout=5)]
+    assert [len(b) for b in batches] == [4, 2, 1]
+    assert [r for b in batches for r in b] == reqs
+    assert q.depth == 0
+    q.close()
+
+
+def test_queue_groups_by_shape_bucket_oldest_first():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    a1, c1 = _req(bucket=(64, 96)), _req(bucket=(96, 128))
+    a2, c2 = _req(bucket=(64, 96)), _req(bucket=(96, 128))
+    for r in (a1, c1, a2, c2):      # interleaved submission
+        q.submit(r)
+    b1 = q.pop(timeout=5)            # oldest head: the (64, 96) bucket
+    assert b1 == [a1, a2]
+    b2 = q.pop(timeout=5)
+    assert b2 == [c1, c2]
+    q.close()
+
+
+def test_queue_continuous_dispatch_no_timer_stall():
+    """The idle-device regression pin (round 6's flush loop made requests
+    age toward max_wait while the device sat idle): a blocked pop returns
+    the moment a request is submitted, and a single queued request
+    dispatches alone rather than waiting for batch-mates."""
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    got = {}
+
+    def consumer():
         t0 = time.monotonic()
-        b.submit(_req())
-        b.submit(_req())
-        assert sink.event.wait(timeout=5.0)
-        elapsed = time.monotonic() - t0
-        assert [len(x) for x in sink.batches] == [2]
-        assert elapsed >= 0.025, "must not flush before max_wait"
-    finally:
-        b.close()
+        got["batch"] = q.pop(timeout=10)
+        got["gap_s"] = time.monotonic() - got["batch"][0].t_enqueue
+        got["wait_s"] = time.monotonic() - t0
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.2)                  # consumer is idle, queue empty
+    q.submit(_req())
+    t.join(timeout=5)
+    assert got["batch"] is not None and len(got["batch"]) == 1
+    assert got["gap_s"] < 0.15, \
+        f"idle worker must pick up immediately, waited {got['gap_s']:.3f}s"
+    # pop with nothing queued honors its timeout
+    assert q.pop(timeout=0.05) is None
+    q.close()
 
 
-def test_batcher_deadline_rejection_at_dispatch():
-    sink = _Collector()
-    b = MicroBatcher(sink, max_batch=8, max_wait_ms=50, max_queue=16)
-    try:
-        dead = _req(deadline_s=0.001)   # expires long before the 50 ms flush
-        live = _req(deadline_s=30.0)
-        b.submit(dead)
-        b.submit(live)
-        with pytest.raises(DeadlineExceeded):
-            dead.future.result(timeout=5.0)
-        assert sink.event.wait(timeout=5.0)
-        assert [len(x) for x in sink.batches] == [1]  # only the live one
-        assert sink.batches[0][0] is live
-        assert b.metrics.deadline_missed.value == 1
-    finally:
-        b.close()
+def test_queue_pause_stages_exact_depth():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    q.pause()
+    for _ in range(5):
+        q.submit(_req())
+    assert q.pop(timeout=0.1) is None, "paused queue must not hand out work"
+    q.resume()
+    assert len(q.pop(timeout=5)) == 4
+    assert len(q.pop(timeout=5)) == 1
+    q.close()
 
 
-def test_batcher_queue_full_sheds_with_typed_overloaded():
-    sink = _Collector(block=True)   # saturated worker pool
-    b = MicroBatcher(sink, max_batch=2, max_wait_ms=10_000, max_queue=4)
-    try:
-        for _ in range(4):
-            b.submit(_req())
-        # bucket flushes at 2, but dispatch is blocked -> 2 drain at most
-        time.sleep(0.05)
-        shed = 0
-        for _ in range(6):
-            try:
-                b.submit(_req())
-            except Overloaded as e:
-                assert not e.draining
-                shed += 1
-        assert shed > 0, "bounded queue must shed past max_queue"
-        assert b.metrics.rejected_queue_full.value == shed
-        assert b.depth <= 4
-    finally:
-        sink.release()
-        b.close()
+def test_queue_deadline_rejection_at_pop():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    dead = _req(deadline_s=0.001)
+    live = _req(deadline_s=30.0)
+    q.submit(dead)
+    q.submit(live)
+    time.sleep(0.01)                 # let the deadline pass
+    batch = q.pop(timeout=5)
+    assert batch == [live], "expired request must be triaged out"
+    with pytest.raises(DeadlineExceeded):
+        dead.future.result(timeout=1)
+    assert q.metrics.deadline_missed.value == 1
+    # inflight counts only the live survivor
+    assert q.metrics.inflight.value == 1
+    q.close()
 
 
-def test_batcher_drain_flushes_then_refuses():
-    sink = _Collector()
-    b = MicroBatcher(sink, max_batch=8, max_wait_ms=60_000, max_queue=16)
-    try:
-        reqs = [_req() for _ in range(3)]
-        for r in reqs:
-            b.submit(r)
-        assert not sink.batches, "nothing is due before max_wait"
-        assert b.drain(timeout=5.0), "drain must flush the queue"
-        assert [len(x) for x in sink.batches] == [3]
-        with pytest.raises(Overloaded) as ei:
-            b.submit(_req())
-        assert ei.value.draining
-        assert b.metrics.rejected_draining.value == 1
-    finally:
-        b.close()
+def test_queue_full_sheds_with_typed_overloaded():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=4)
+    for _ in range(4):               # no consumer: the queue fills
+        q.submit(_req())
+    shed = 0
+    for _ in range(6):
+        try:
+            q.submit(_req())
+        except Overloaded as e:
+            assert not e.draining
+            shed += 1
+    assert shed == 6, "bounded queue must shed past max_queue"
+    assert q.metrics.rejected_queue_full.value == shed
+    assert q.depth == 4
+    q.close()
 
 
-def test_batcher_close_fails_orphans():
-    sink = _Collector(block=True)
-    b = MicroBatcher(sink, max_batch=1, max_wait_ms=10_000, max_queue=16)
-    inflight = _req()
-    b.submit(inflight)       # dispatched, stuck in the blocked sink
-    time.sleep(0.05)
+def test_queue_drain_waits_for_consumers_then_refuses():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
+    for _ in range(3):
+        q.submit(_req())
+
+    def consumer():
+        while q.pop(timeout=1) is not None:
+            pass
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    assert q.drain(timeout=5.0), "drain must wait out the queue"
+    with pytest.raises(Overloaded) as ei:
+        q.submit(_req())
+    assert ei.value.draining
+    assert q.metrics.rejected_draining.value == 1
+    q.close()
+    t.join(timeout=5)
+
+
+def test_queue_close_fails_orphans():
+    q = BucketQueue(max_batch=8, batch_sizes=(1, 2, 4, 8), max_queue=16)
     orphan = _req()
-    b.submit(orphan)
-    b.close()
+    q.submit(orphan)
+    q.close()
     with pytest.raises(Overloaded):
         orphan.future.result(timeout=5.0)
-    sink.release()
+    assert q.pop(timeout=0.1) is None, "closed queue wakes workers with None"
+
+
+def test_queue_validates_batch_sizes():
+    with pytest.raises(ValueError, match="include 1"):
+        BucketQueue(max_batch=8, batch_sizes=(2, 4))
+    with pytest.raises(ValueError, match="include 1"):
+        BucketQueue(max_batch=1, batch_sizes=(2,))   # capped away entirely
+    q = BucketQueue(max_batch=3, batch_sizes=(1, 2, 4, 8))
+    assert q.sizes == (1, 2), "sizes cap at max_batch"
+    q.close()
+
+
+# ------------------------------------------------------------ bucket policy
+def test_bucket_policy_static_is_reference_padding():
+    p = BucketPolicy(grids=(32,))
+    assert not p.adaptive
+    assert p.bucket_for(48, 64) == (64, 64, 32)
+    assert p.bucket_for(375, 1242) == (384, 1248, 32)
+    # feedback is a no-op in static mode
+    p.note((64, 64), real_px=1, dispatched_px=4096)
+    assert p.bucket_for(48, 64) == (64, 64, 32)
+    assert p.refined_buckets == ()
+
+
+def test_bucket_policy_refines_on_measured_waste():
+    reg = MetricsRegistry()
+    c = reg.counter("refine_total", "refinements")
+    p = BucketPolicy(grids=(128, 32), max_waste=0.10,
+                     refinements_counter=c)
+    assert p.adaptive
+    # a new shape starts at the coarsest grid
+    assert p.bucket_for(40, 70) == (128, 128, 128)
+    # measured waste under the bound: bucket stays
+    p.note((128, 128), real_px=15500, dispatched_px=16384)
+    assert p.bucket_for(40, 70) == (128, 128, 128)
+    # waste crosses the bound -> the bucket refines to the finer grid
+    p.note((128, 128), real_px=2800, dispatched_px=16384)
+    assert p.bucket_for(40, 70) == (64, 96, 32)
+    assert p.refined_buckets == ((128, 128),)
+    assert c.value == 1
+    # the /32 floor is irreducible: waste there never re-routes
+    p.note((64, 96), real_px=100, dispatched_px=6144)
+    assert p.bucket_for(40, 70) == (64, 96, 32)
+    with pytest.raises(ValueError, match="multiples"):
+        BucketPolicy(grids=(48,))
 
 
 # --------------------------------------------------------------- metrics
@@ -203,7 +270,20 @@ def test_metrics_exposition_and_percentiles():
     assert "serve_requests_admitted_total 1" in sm.render_text()
 
 
-# --------------------------------------------------------------- service
+def test_metrics_dispatch_size_family():
+    sm = ServingMetrics(max_batch=8)
+    sm.observe_dispatch(4)
+    sm.observe_dispatch(4)
+    sm.observe_dispatch(1)
+    assert sm.batches.value == 3
+    assert sm.dispatches_at(4) == 2 and sm.dispatches_at(1) == 1
+    assert sm.dispatches_at(8) == 0
+    text = sm.render_text()
+    assert 'serve_dispatches_total{batch="4"} 2' in text
+    assert 'serve_dispatches_total{batch="1"} 1' in text
+
+
+# ---------------------------------------------------------------- engine
 @pytest.fixture(scope="module")
 def tiny_model():
     import jax
@@ -228,30 +308,124 @@ def _pairs(n, hw=(48, 64), seed=3):
     return lefts, rights
 
 
-def test_service_batched_bitwise_parity_with_solo_runner(tiny_model):
-    """The acceptance property: a response that rode a micro-batch is
-    bitwise equal to the same pair run alone through InferenceRunner
-    (chain mode dispatches through the identical batch-1 program)."""
+def _staged(svc, lefts, rights):
+    """Submit all pairs with the queue paused, then release: the next pop
+    sees the exact depth, so dispatch batch sizes are deterministic."""
+    svc.queue.pause()
+    futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+    svc.queue.resume()
+    return [f.result(timeout=120) for f in futures]
+
+
+def _assert_matches_solo(res, solo_flow, what=""):
+    """The engine's parity contract per batch-size bucket: batch 1 runs
+    the identical compiled program as the solo runner — bitwise equal (the
+    reason the old chain semantics survive as the batch-1 bucket).  A
+    batch-N executable reassociates reductions differently (~1e-5, the
+    drift the round-6 stack mode documented), so N > 1 asserts the
+    documented tolerance."""
+    assert res.flow.shape == solo_flow.shape
+    if res.batch_size == 1:
+        assert np.array_equal(res.flow, solo_flow), \
+            f"batch-1 bucket must be bitwise-equal to solo {what}"
+    else:
+        np.testing.assert_allclose(res.flow, solo_flow, atol=5e-4,
+                                   err_msg=what)
+
+
+def test_engine_batch1_bitwise_parity_with_solo_runner(tiny_model):
+    """The acceptance property: the batch-1 bucket (the old chain mode)
+    dispatches the identical compiled program solo InferenceRunner uses —
+    responses are bitwise equal."""
     from raft_stereo_tpu.eval.runner import InferenceRunner
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
     cfg, variables = tiny_model
     solo = InferenceRunner(cfg, variables, iters=ITERS)
-    lefts, rights = _pairs(3)
+    lefts, rights = _pairs(2)
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=3, max_wait_ms=200,
-                                   iters=ITERS)) as svc:
-        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
-        results = [f.result(timeout=120) for f in futures]
-    assert all(r.batch_size == 3 for r in results), \
-        "the three submits must ride one micro-batch"
-    for (l, r), res in zip(zip(lefts, rights), results):
-        solo_flow, _ = solo(l, r)
-        assert res.flow.shape == solo_flow.shape == (48, 64)
-        assert np.array_equal(res.flow, solo_flow), \
-            "batched response must be bitwise-equal to solo inference"
-        assert res.queue_wait_s >= 0 and res.total_s > 0
-        np.testing.assert_array_equal(res.disparity, -res.flow)
+                       ServeConfig(max_batch=8, iters=ITERS)) as svc:
+        for l, r in zip(lefts, rights):
+            res = svc.infer(l, r, timeout=120)   # sequential -> batch 1
+            solo_flow, _ = solo(l, r)
+            assert res.batch_size == 1
+            assert res.flow.shape == solo_flow.shape == (48, 64)
+            assert np.array_equal(res.flow, solo_flow), \
+                "batch-1 bucket must be bitwise-equal to solo inference"
+            assert res.queue_wait_s >= 0 and res.total_s > 0
+            np.testing.assert_array_equal(res.disparity, -res.flow)
+
+
+def test_engine_bucket_ladder_parity_with_solo(tiny_model):
+    """Satellite: every batch-size bucket (1/2/4/8) matches solo inference
+    — batch 1 bitwise, batch N within the documented reassociation
+    tolerance — and each staged burst runs as ONE dispatch of exactly
+    that size."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    lefts, rights = _pairs(8)
+    expect = [np.array(solo(l, r)[0]) for l, r in zip(lefts, rights)]
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=8, iters=ITERS)) as svc:
+        assert svc.queue.sizes == (1, 2, 4, 8)
+        for k in (1, 2, 4, 8):
+            before = svc.metrics.dispatches_at(k)
+            results = _staged(svc, lefts[:k], rights[:k])
+            assert [r.batch_size for r in results] == [k] * k
+            assert svc.metrics.dispatches_at(k) == before + 1
+            for i, (res, want) in enumerate(zip(results, expect[:k])):
+                _assert_matches_solo(res, want, f"batch-{k} result {i}")
+
+
+def test_engine_partial_occupancy_decomposes_no_filler(tiny_model):
+    """Satellite: a partial batch dispatches at the next size down (3 ->
+    2+1, 7 -> 4+2+1) instead of pow2-padding — fewer dispatches than
+    requests, zero filler frames, every result matching solo."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    lefts, rights = _pairs(7)
+    expect = [np.array(solo(l, r)[0]) for l, r in zip(lefts, rights)]
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=8, iters=ITERS)) as svc:
+        d0 = svc.metrics.batches.value
+        results = _staged(svc, lefts[:3], rights[:3])
+        assert sorted(r.batch_size for r in results) == [1, 2, 2]
+        assert svc.metrics.batches.value - d0 == 2   # 3 requests, 2 dispatches
+        d0 = svc.metrics.batches.value
+        results = _staged(svc, lefts, rights)        # depth 7 -> 4+2+1
+        assert svc.metrics.batches.value - d0 == 3
+        assert sorted(r.batch_size for r in results) == [1, 2, 2, 4, 4, 4, 4]
+        for i, (res, want) in enumerate(zip(results, expect)):
+            _assert_matches_solo(res, want, f"partial-occupancy result {i}")
+        # the engine-level acceptance: dispatches < completed requests
+        assert svc.metrics.batches.value < svc.metrics.completed.value
+        # occupancy histogram counts every dispatch
+        assert svc.metrics.batch_occupancy.count == svc.metrics.batches.value
+
+
+def test_engine_dispatch_gap_regression(tiny_model):
+    """Satellite: the idle-device queue-wait pathology is gone — a request
+    arriving at an idle engine is picked up immediately; the retired
+    max_wait_ms cannot stall it (round 6: queue-wait p95 ~4 s at offered
+    1.91 Hz while the device sat idle between flushes)."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=8, iters=ITERS,
+                                   max_wait_ms=60_000)) as svc:
+        svc.infer(lefts[0], rights[0], timeout=120)  # absorb compile
+        res = svc.infer(lefts[0], rights[0], timeout=120)
+        assert res.queue_wait_s < 1.0, \
+            f"idle engine must dispatch immediately, waited " \
+            f"{res.queue_wait_s:.3f}s"
 
 
 def test_service_buckets_mixed_shapes_and_unpads_exactly(tiny_model):
@@ -267,21 +441,22 @@ def test_service_buckets_mixed_shapes_and_unpads_exactly(tiny_model):
     pairs = [(rng.integers(0, 255, s + (3,), dtype=np.uint8),) * 2
              for s in shapes]
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=4, max_wait_ms=30,
-                                   iters=ITERS)) as svc:
+                       ServeConfig(max_batch=4, iters=ITERS)) as svc:
         assert svc.bucket_for((48, 64, 3)) == (64, 64)
         assert svc.bucket_for((40, 56, 3)) == (64, 64)
         assert svc.bucket_for((48, 96, 3)) == (64, 96)
+        svc.queue.pause()
         futures = [svc.submit(l, r) for l, r in pairs]
+        svc.queue.resume()
         results = [f.result(timeout=120) for f in futures]
         for (l, r), res, shape in zip(pairs, results, shapes):
             assert res.flow.shape == shape
             solo_flow, _ = solo(l, r)
-            assert np.array_equal(res.flow, solo_flow)
-        # metrics saw every stage
+            _assert_matches_solo(res, solo_flow)
+        # metrics saw every stage: 2 dispatches (a 2 and a 1)
         m = svc.metrics
         assert m.completed.value == 3
-        assert m.batches.value >= 2          # two distinct buckets
+        assert m.batches.value == 2
         assert m.queue_wait.count == 3 and m.total_latency.count == 3
 
 
@@ -294,7 +469,7 @@ def test_service_overload_burst_sheds_and_completes_admitted(tiny_model):
     cfg, variables = tiny_model
     lefts, rights = _pairs(1)
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=2, max_wait_ms=1.0, max_queue=4,
+                       ServeConfig(max_batch=2, max_queue=4,
                                    iters=ITERS)) as svc:
         svc.infer(lefts[0], rights[0], timeout=120)   # warm the executable
         futures, shed = [], 0
@@ -323,11 +498,9 @@ def test_service_drain_finishes_queued_then_refuses(tiny_model):
     cfg, variables = tiny_model
     lefts, rights = _pairs(1)
     svc = StereoService(cfg, variables,
-                        ServeConfig(max_batch=4, max_wait_ms=60_000,
-                                    iters=ITERS))
+                        ServeConfig(max_batch=4, iters=ITERS))
     try:
         futures = [svc.submit(lefts[0], rights[0]) for _ in range(3)]
-        # nothing flushes on its own (max_wait is a minute); drain must
         assert svc.drain(timeout=120)
         for f in futures:
             assert np.isfinite(f.result(timeout=1).flow).all()
@@ -342,36 +515,52 @@ def test_serve_config_validation(tiny_model):
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
     cfg, variables = tiny_model
-    with pytest.raises(ValueError, match="batch_mode"):
-        ServeConfig(batch_mode="magic")
+    with pytest.raises(ValueError, match="include 1"):
+        ServeConfig(batch_sizes=(2, 4))
     with pytest.raises(ValueError, match="data_parallel"):
         ServeConfig(data_parallel=0)
+    with pytest.raises(ValueError, match="max_padding_waste"):
+        ServeConfig(max_padding_waste=1.5)
+    with pytest.raises(ValueError, match="multiple"):
+        ServeConfig(bucket_grids=(48,))
+    with pytest.raises(ValueError, match="multiple"):
+        ServeConfig(shape_bucket=40)
     with pytest.raises(ValueError, match="exceeds"):
         StereoService(cfg, variables, ServeConfig(data_parallel=512))
 
 
-def test_service_stack_mode_close_to_solo(tiny_model):
-    """Stack mode (one batched dispatch, batch-padded to max_batch) stays
-    within the documented cross-batch-size reassociation drift."""
-    from raft_stereo_tpu.eval.runner import InferenceRunner
+def test_engine_adaptive_buckets_waste_feedback(tiny_model):
+    """The waste feedback loop end to end: a wasteful shape starts at the
+    coarse grid, the measured serve_bucket_*_pixels accounting crosses the
+    threshold, and the NEXT request re-routes to the /32 floor bucket —
+    with results identical either way (padding never changes unpadded
+    numerics' shape contract)."""
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
     cfg, variables = tiny_model
-    solo = InferenceRunner(cfg, variables, iters=ITERS)
-    lefts, rights = _pairs(3)
-    with StereoService(cfg, variables,
-                       ServeConfig(max_batch=4, max_wait_ms=50,
-                                   batch_mode="stack", iters=ITERS)) as svc:
-        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
-        for (l, r), f in zip(zip(lefts, rights), futures):
-            res = f.result(timeout=120)
-            solo_flow, _ = solo(l, r)
-            np.testing.assert_allclose(res.flow, solo_flow, atol=5e-4)
+    rng = np.random.default_rng(5)
+    left = rng.integers(0, 255, (40, 70, 3), dtype=np.uint8)
+    right = np.roll(left, -3, axis=1)
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS, adaptive_buckets=True,
+            bucket_grids=(128, 32), max_padding_waste=0.10)) as svc:
+        assert svc.bucket_for((40, 70, 3)) == (128, 128)   # coarse start
+        r1 = svc.infer(left, right, timeout=120)
+        # waste 1 - 2800/16384 ~= 83% > 10% -> the bucket refined
+        assert svc.policy.refined_buckets == ((128, 128),)
+        assert svc.metrics.bucket_refinements.value == 1
+        assert svc.bucket_for((40, 70, 3)) == (64, 96)     # /32 floor
+        r2 = svc.infer(left, right, timeout=120)
+        assert r1.flow.shape == r2.flow.shape == (40, 70)
+        assert np.isfinite(r2.flow).all()
+        text = svc.metrics.render_text()
+        assert 'serve_bucket_real_pixels_total{bucket="128x128"}' in text
+        assert 'serve_bucket_real_pixels_total{bucket="64x96"}' in text
 
 
 def test_service_data_parallel_workers(tiny_model):
     """Multiple device workers (the 8 virtual CPU devices) serve the same
-    traffic with the same chain-mode parity."""
+    traffic with the same batch-1 parity."""
     from raft_stereo_tpu.eval.runner import InferenceRunner
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
@@ -379,19 +568,98 @@ def test_service_data_parallel_workers(tiny_model):
     solo = InferenceRunner(cfg, variables, iters=ITERS)
     lefts, rights = _pairs(4)
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=2, max_wait_ms=5.0,
-                                   data_parallel=2, iters=ITERS)) as svc:
+                       ServeConfig(max_batch=2, data_parallel=2,
+                                   iters=ITERS)) as svc:
         assert len(svc.devices) == 2
         futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
         for (l, r), f in zip(zip(lefts, rights), futures):
             res = f.result(timeout=120)
             solo_flow, _ = solo(l, r)
-            assert np.array_equal(res.flow, solo_flow)
+            _assert_matches_solo(res, solo_flow)
+
+
+def test_engine_prewarm_compiles_bucket_ladder(tiny_model):
+    """prewarm builds every batch-size executable for a shape at boot (via
+    the cost registry, so the records prove which rungs exist)."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=4, iters=ITERS, cost_telemetry=True,
+            warmup_shapes=((48, 64),))) as svc:
+        keys = sorted(r.key for r in svc.costs.records())
+        assert keys == ["serving.forward(64x64,b1)",
+                        "serving.forward(64x64,b2)",
+                        "serving.forward(64x64,b4)"]
+        # the warm executables serve real traffic without recompiling
+        lefts, rights = _pairs(2)
+        results = _staged(svc, lefts, rights)
+        assert [r.batch_size for r in results] == [2, 2]
+        assert len(svc.costs.records()) == 3
+
+
+def test_engine_donation_and_memory_analysis(tiny_model):
+    """Satellite: image buffers are donated in the engine's bucket
+    executables and the solo runner; the registry's memory_analysis record
+    carries the donation accounting.  XLA only aliases a donated input to
+    an output of the SAME byte size — the stereo forward's f32 flow can
+    never reuse the uint8 image buffers, so its alias bytes are honestly
+    0 and the saving is pinned on an aliasable executable through the
+    same registry path (hbm_bytes drops by exactly the aliased output)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.telemetry.costs import CompileRegistry
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+
+    # (a) the registry records an aliasable donated executable's saving
+    reg = CompileRegistry()
+    donated = reg.instrument(
+        jax.jit(lambda x: x * 2.0 + 1.0, donate_argnums=0),
+        key="toy.donated", site="eval")
+    plain = reg.instrument(jax.jit(lambda x: x * 2.0 + 1.0),
+                           key="toy.plain", site="eval")
+    np.testing.assert_array_equal(
+        np.asarray(donated(jnp.ones((128, 128), jnp.float32))),
+        np.asarray(plain(jnp.ones((128, 128), jnp.float32))))
+    rd, rp = reg.get("toy.donated"), reg.get("toy.plain")
+    out_bytes = rd.memory["output_size_in_bytes"]
+    assert rd.donated_alias_bytes == out_bytes > 0, \
+        "donated same-size output must alias the input buffer"
+    assert rp.donated_alias_bytes == 0
+    assert rd.hbm_bytes == rp.hbm_bytes - out_bytes, \
+        "the HBM saving is exactly the aliased output allocation"
+
+    # (b) the engine's bucket executables declare donation, record their
+    # memory analysis, and stay bitwise-equal to a non-donating runner
+    solo_nodonate = InferenceRunner(cfg, variables, iters=ITERS,
+                                    donate_images=False)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, iters=ITERS,
+                                   cost_telemetry=True)) as svc:
+        assert svc.serve_cfg.donate_buffers
+        res = svc.infer(lefts[0], rights[0], timeout=120)
+        flow, _ = solo_nodonate(lefts[0], rights[0])
+        assert np.array_equal(res.flow, flow), \
+            "donation must not change numerics"
+        rec = svc.compiled_cost((64, 64), batch=1)
+        assert rec is not None and rec.memory is not None
+        assert rec.memory["argument_size_in_bytes"] > 0
+        assert rec.donated_alias_bytes == 0   # no same-size output exists
+        assert rec.hbm_bytes == sum(
+            rec.memory.get(f, 0) for f in
+            ("argument_size_in_bytes", "output_size_in_bytes",
+             "temp_size_in_bytes"))
 
 
 def test_serve_cli_builds_service_from_checkpoint(tiny_model, tmp_path):
-    """cli.serve: argparse -> checkpoint load -> configured service (the
-    raft-serve console path minus the blocking HTTP loop)."""
+    """cli.serve: argparse -> checkpoint load -> configured engine (the
+    raft-serve console path minus the blocking HTTP loop).  The retired
+    --max_wait_ms flag is still accepted."""
     from raft_stereo_tpu.cli.serve import build_parser, build_service
     from raft_stereo_tpu.training.checkpoint import save_weights
 
@@ -401,11 +669,12 @@ def test_serve_cli_builds_service_from_checkpoint(tiny_model, tmp_path):
                  variables.get("batch_stats"))
     args = build_parser().parse_args(
         ["--restore_ckpt", path, "--valid_iters", str(ITERS),
-         "--max_batch", "2", "--max_wait_ms", "3", "--max_queue", "8",
-         "--deadline_ms", "60000"])
+         "--max_batch", "2", "--batch_sizes", "1,2,4", "--max_queue", "8",
+         "--max_wait_ms", "3", "--deadline_ms", "60000"])
     svc = build_service(args)
     try:
         assert svc.serve_cfg.max_batch == 2
+        assert svc.queue.sizes == (1, 2)     # capped at max_batch
         assert svc.serve_cfg.default_deadline_ms == 60000
         lefts, rights = _pairs(1)
         res = svc.infer(lefts[0], rights[0], timeout=120)
@@ -422,8 +691,7 @@ def http_server(tiny_model):
 
     cfg, variables = tiny_model
     svc = StereoService(cfg, variables,
-                        ServeConfig(max_batch=2, max_wait_ms=5.0,
-                                    iters=ITERS))
+                        ServeConfig(max_batch=2, iters=ITERS))
     server = StereoHTTPServer(svc, port=0).start()
     yield server
     server.shutdown()
@@ -472,9 +740,10 @@ def test_http_disparity_npz_to_npy_and_metrics(http_server, tiny_model):
     assert "serve_requests_completed_total 1" in text
     assert "serve_total_latency_seconds_count 1" in text
     assert "serve_last_batch_unix_seconds" in text
+    assert 'serve_dispatches_total{batch="1"} 1' in text
 
-    # Satellite (ISSUE 4): healthz matches the train endpoint's shape —
-    # status, queue depth, inflight count, last-batch age.
+    # healthz matches the train endpoint's shape — status, queue depth,
+    # inflight count, last-batch age.
     with urllib.request.urlopen(http_server.url + "/healthz",
                                 timeout=30) as resp:
         health = json.loads(resp.read())
@@ -520,21 +789,20 @@ def test_http_error_mapping(http_server):
     assert status == 400
 
 
-# -------------------------------------------- request-path tracing (ISSUE 4)
+# ------------------------------------------------ request-path tracing
 def test_served_request_span_tree_under_full_sampling(tiny_model):
-    """Acceptance: a served request under sampling=1.0 yields a span tree
-    covering admission -> queue -> dispatch -> fetch whose export is valid
-    Chrome trace-event JSON with the documented attributes."""
+    """A served request under sampling=1.0 yields a span tree covering
+    admission -> queue -> dispatch -> fetch whose export is valid Chrome
+    trace-event JSON with the documented attributes."""
     from raft_stereo_tpu.serving import ServeConfig, StereoService
     from raft_stereo_tpu.telemetry import to_chrome_trace
 
     cfg, variables = tiny_model
     lefts, rights = _pairs(2)
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=2, max_wait_ms=30, iters=ITERS,
+                       ServeConfig(max_batch=2, iters=ITERS,
                                    trace_sample_rate=1.0)) as svc:
-        futures = [svc.submit(l, r) for l, r in zip(lefts, rights)]
-        results = [f.result(timeout=120) for f in futures]
+        results = _staged(svc, lefts, rights)   # one batch-2 dispatch
         assert all(np.isfinite(r.flow).all() for r in results)
         spans = svc.tracer.spans()
         tracer = svc.tracer
@@ -580,8 +848,7 @@ def test_serving_default_has_tracing_off(tiny_model):
     cfg, variables = tiny_model
     lefts, rights = _pairs(1)
     with StereoService(cfg, variables,
-                       ServeConfig(max_batch=1, max_wait_ms=1.0,
-                                   iters=ITERS)) as svc:
+                       ServeConfig(max_batch=1, iters=ITERS)) as svc:
         assert not svc.tracer.enabled
         svc.infer(lefts[0], rights[0], timeout=120)
         assert svc.tracer.spans() == []
@@ -598,8 +865,8 @@ def debug_http_server(tiny_model, tmp_path):
 
     cfg, variables = tiny_model
     svc = StereoService(cfg, variables,
-                        ServeConfig(max_batch=2, max_wait_ms=5.0,
-                                    iters=ITERS, trace_sample_rate=1.0))
+                        ServeConfig(max_batch=2, iters=ITERS,
+                                    trace_sample_rate=1.0))
     recorder = FlightRecorder(str(tmp_path / "fr"), tracer=svc.tracer,
                               registry=svc.metrics.registry,
                               min_interval_s=0.0)
@@ -657,7 +924,7 @@ def test_http_debug_surface(debug_http_server):
 
 def test_serve_cli_wires_observability(tiny_model, tmp_path):
     """cli.serve: --trace_sample_rate/--watchdog/--event_log build the
-    tracer + recorder + watchdog around the service."""
+    tracer + recorder + watchdog around the engine."""
     from raft_stereo_tpu.cli.serve import (build_observability, build_parser,
                                            build_service)
     from raft_stereo_tpu.training.checkpoint import save_weights
