@@ -108,6 +108,48 @@ def check_regression(split: dict, fps: float) -> list:
     return warnings
 
 
+def tier_latency_split(cfg, variables, img1, img2, fixed_s: float) -> list:
+    """Per-tier chained latency at the bench's fixed input vs the
+    fixed-depth program (config.REQUEST_TIERS — the serving engine's
+    per-request early-exit presets).  Random bench inputs on seeded init
+    weights rarely converge, so ``iters_used`` is reported next to every
+    time: the latency win is a function of the OBSERVED trip count
+    (EARLY_EXIT_r12.json carries the trained-weights curve); a tier may
+    tie the baseline here but must never exceed it beyond the noise band
+    (warn line)."""
+    from raft_stereo_tpu.config import REQUEST_TIERS
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    rows = []
+    for tier in REQUEST_TIERS.values():
+        t_cfg = tier.apply(cfg)
+        t_model = RAFTStereo(t_cfg)
+        adaptive = t_cfg.exit_threshold_px > 0
+        secs = _seconds_per_forward(t_model, variables, img1, img2,
+                                    BENCH_ITERS)
+        if adaptive:   # one un-chained apply fetches the trip count
+            out = t_model.apply(variables, img1, img2, iters=BENCH_ITERS,
+                                test_mode=True)
+            iters_used = int(out[2])
+        else:
+            iters_used = BENCH_ITERS
+        row = {
+            "tier": tier.name,
+            "exit_threshold_px": tier.exit_threshold_px,
+            "min_iters": tier.min_iters,
+            "per_image_ms": round(secs * 1e3, 3),
+            "vs_fixed": round(secs / fixed_s, 3),
+            "iters_used": iters_used,
+            "iters_cap": BENCH_ITERS,
+        }
+        if secs > REGRESSION_FACTOR * fixed_s:
+            row["warning"] = (f"tier {tier.name} is {secs / fixed_s:.2f}x "
+                              f"the fixed-depth program — early-exit "
+                              f"overhead regression")
+        rows.append(row)
+    return rows
+
+
 def main():
     from raft_stereo_tpu.config import RaftStereoConfig
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
@@ -150,6 +192,13 @@ def main():
     split = phase_split(per_image, t_one, BENCH_ITERS)
     split["fused_gru"] = cfg.fused_gru
     print(json.dumps(split))
+    # Per-tier chained latency (adaptive early exit, config.REQUEST_TIERS)
+    # against the fixed-depth program just measured.
+    print(json.dumps({
+        "metric": "realtime_tier_latency",
+        "fixed_per_image_ms": round(per_image * 1e3, 3),
+        "tiers": tier_latency_split(cfg, variables, img1, img2, per_image),
+    }))
     for warning in check_regression(split, fps):
         print(json.dumps(warning))
 

@@ -38,7 +38,7 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_REPO, "tests"))
 
-OUT = "BENCH_SERVE_r11.json"
+OUT = "BENCH_SERVE_r12.json"
 BASELINE = "BENCH_SERVE_r06.json"
 
 
@@ -127,6 +127,57 @@ def occupancy_sweep(cfg, variables, hw, iters, rng,
     finally:
         svc.close()
     return out
+
+
+def tier_sweep(cfg, variables, hw, iters, rng, requests: int = 6) -> list:
+    """Per-tier request latency through the engine vs the fixed-depth
+    baseline tier: sequential solo requests per configured tier (batch 1,
+    the latency-critical path), p50/p95 plus the mean ``iters_used`` the
+    convergence gate actually ran.  Bench inputs are random and the bench
+    weights are seeded init, so the adaptive tiers may run to the cap —
+    ``iters_used`` next to each time keeps the row honest (the trained-
+    weights accuracy/latency curve lives in EARLY_EXIT_r12.json).  WARNS
+    when an adaptive tier's p50 exceeds the quality tier's beyond the
+    noise band (early-exit overhead must never cost latency)."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    lefts, rights = _pairs(hw, 4, rng)
+    # The depth must leave the gate room on CPU runs (the fixed CPU bench
+    # depth of 2 cannot exit early past min_iters).
+    iters = max(iters, 6)
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=iters, cost_telemetry=True,
+        tiers=("interactive", "balanced", "quality")))
+    rows = []
+    try:
+        svc.prewarm(hw)        # every tier's executable family
+        for tier in ("quality", "balanced", "interactive"):
+            results = [svc.infer(lefts[i % 4], rights[i % 4], tier=tier,
+                                 timeout=600) for i in range(requests)]
+            total = np.array([r.total_s for r in results])
+            rows.append({
+                "tier": tier,
+                "requests": requests,
+                "iters_cap": iters,
+                "iters_used_mean": round(float(np.mean(
+                    [r.iters_used for r in results])), 2),
+                "latency_ms": {
+                    "p50": round(float(np.percentile(total, 50)) * 1e3, 1),
+                    "p95": round(float(np.percentile(total, 95)) * 1e3, 1),
+                    "mean": round(float(total.mean()) * 1e3, 1)},
+            })
+            print(json.dumps({"tier_sweep": rows[-1]}), flush=True)
+        fixed_p50 = rows[0]["latency_ms"]["p50"]   # quality = fixed depth
+        for row in rows[1:]:
+            if row["latency_ms"]["p50"] > 1.25 * fixed_p50:
+                row["regression_vs_fixed"] = True
+                print(f"WARNING: tier {row['tier']} p50 "
+                      f"{row['latency_ms']['p50']} ms > 1.25x fixed-depth "
+                      f"{fixed_p50} ms — early-exit overhead regression",
+                      flush=True)
+    finally:
+        svc.close()
+    return rows
 
 
 def offered_load_run(cfg, variables, hw, iters, rate_hz: float,
@@ -246,6 +297,10 @@ def main():
     sweep = occupancy_sweep(cfg, variables, hw, iters, rng,
                             rounds=4 if on_cpu else 6)
 
+    # --- per-tier request latency (adaptive early exit) vs fixed depth
+    tiers = tier_sweep(cfg, variables, hw, iters, rng,
+                       requests=4 if on_cpu else 12)
+
     # --- offered loads.  Relative to the solo rate: 0.7x (below capacity —
     # latency should sit near solo, batch 1 dominates) and 1.5x (beyond a
     # single caller — continuous batching deepens occupancy to keep up).
@@ -273,6 +328,7 @@ def main():
         "best_vs_solo": round(best["throughput_hz"] / solo_hz, 3),
         "best_setting": {k: best[k] for k in ("max_batch", "offered_hz")},
         "occupancy_sweep": sweep,
+        "tier_sweep": tiers,
         "runs": runs,
         "baseline_comparison": comparison,
     })

@@ -46,7 +46,9 @@ def run_demo(args) -> int:
     cfg, variables = common.load_any_checkpoint(
         args.restore_ckpt, **common.arch_overrides(args))
     runner = InferenceRunner(cfg, variables, iters=args.valid_iters,
-                             fetch_dtype=args.fetch_dtype)
+                             fetch_dtype=args.fetch_dtype,
+                             exit_threshold_px=args.exit_threshold_px,
+                             exit_min_iters=args.min_iters)
 
     out_dir = args.output_directory
     os.makedirs(out_dir, exist_ok=True)
@@ -67,8 +69,18 @@ def run_demo(args) -> int:
         vis = jet_colormap(disp / max(float(disp.max()), 1e-6))
         Image.fromarray(vis).save(os.path.join(out_dir,
                                                f"{stem}-disparity.png"))
-        log.info("%s: disparity range [%.2f, %.2f]", stem, disp.min(),
-                 disp.max())
+        if runner.last_iters_used is not None:
+            log.info("%s: disparity range [%.2f, %.2f] (iters_used %d/%d)",
+                     stem, disp.min(), disp.max(), runner.last_iters_used,
+                     args.valid_iters)
+        else:
+            log.info("%s: disparity range [%.2f, %.2f]", stem, disp.min(),
+                     disp.max())
+    if runner.iters_used_mean() is not None:
+        log.info("adaptive early exit: mean iters_used %.2f of %d "
+                 "(threshold %.4g px, min %d)", runner.iters_used_mean(),
+                 args.valid_iters, args.exit_threshold_px or 0.0,
+                 args.min_iters or 1)
     return len(left_images)
 
 
@@ -83,6 +95,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output_directory", default="demo_output")
     p.add_argument("--save_numpy", action="store_true")
     p.add_argument("--valid_iters", type=int, default=32)
+    p.add_argument("--exit_threshold_px", type=float, default=None,
+                   help="adaptive GRU early exit: stop refining once the "
+                        "mean |Δdisparity| per iteration falls below this "
+                        "(px at feature resolution; --valid_iters becomes "
+                        "the cap and each image logs its iters_used). "
+                        "<= 0 or unset keeps the fixed-depth loop")
+    p.add_argument("--min_iters", type=int, default=None,
+                   help="iterations that always run before the early-exit "
+                        "threshold may fire (default 1)")
     p.add_argument("--fetch_dtype", default=None,
                    choices=["fp16", "bf16"],
                    help="half-precision device->host disparity fetch "

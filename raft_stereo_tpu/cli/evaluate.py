@@ -29,23 +29,36 @@ def run_eval(args) -> dict:
     cfg, variables = common.load_any_checkpoint(args.restore_ckpt, **overrides)
     log.info("model config: %s", cfg.to_dict())
     runner = InferenceRunner(cfg, variables, iters=args.valid_iters,
-                             fetch_dtype=args.fetch_dtype)
+                             fetch_dtype=args.fetch_dtype,
+                             exit_threshold_px=args.exit_threshold_px,
+                             exit_min_iters=args.min_iters)
 
     root = args.data_root
     if args.dataset == "eth3d":
-        return validate_eth3d(runner, root=f"{root}/ETH3D",
-                              max_images=args.max_images)
-    if args.dataset == "kitti":
-        return validate_kitti(runner, root=f"{root}/KITTI",
-                              max_images=args.max_images)
-    if args.dataset == "things":
-        return validate_things(runner, root=root, max_images=args.max_images)
-    if args.dataset.startswith("middlebury_"):
-        return validate_middlebury(runner, root=f"{root}/Middlebury",
-                                   split=args.dataset.removeprefix(
-                                       "middlebury_"),
-                                   max_images=args.max_images)
-    raise SystemExit(f"unknown dataset {args.dataset!r}")
+        results = validate_eth3d(runner, root=f"{root}/ETH3D",
+                                 max_images=args.max_images)
+    elif args.dataset == "kitti":
+        results = validate_kitti(runner, root=f"{root}/KITTI",
+                                 max_images=args.max_images)
+    elif args.dataset == "things":
+        results = validate_things(runner, root=root,
+                                  max_images=args.max_images)
+    elif args.dataset.startswith("middlebury_"):
+        results = validate_middlebury(runner, root=f"{root}/Middlebury",
+                                      split=args.dataset.removeprefix(
+                                          "middlebury_"),
+                                      max_images=args.max_images)
+    else:
+        raise SystemExit(f"unknown dataset {args.dataset!r}")
+    if runner.iters_used_mean() is not None:
+        # The accuracy/latency knob, visible outside the server: the mean
+        # GRU trip count the convergence gate actually ran.
+        results[f"{args.dataset}-iters-used-mean"] = round(
+            runner.iters_used_mean(), 3)
+        print(f"Adaptive early exit: mean iters_used "
+              f"{runner.iters_used_mean():.2f} of {args.valid_iters} "
+              f"(threshold {args.exit_threshold_px} px)")
+    return results
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -56,7 +69,17 @@ def build_parser() -> argparse.ArgumentParser:
                             "middlebury_H", "middlebury_Q"])
     p.add_argument("--data_root", default="datasets")
     p.add_argument("--valid_iters", type=int, default=32,
-                   help="GRU iterations (reference: --valid_iters)")
+                   help="GRU iterations (reference: --valid_iters); the "
+                        "depth CAP when --exit_threshold_px is set")
+    p.add_argument("--exit_threshold_px", type=float, default=None,
+                   help="adaptive GRU early exit: stop refining once the "
+                        "mean |Δdisparity| per iteration falls below this "
+                        "(px at feature resolution); the result row gains "
+                        "the mean iters_used.  <= 0 or unset keeps the "
+                        "reference's fixed-depth loop")
+    p.add_argument("--min_iters", type=int, default=None,
+                   help="iterations that always run before the early-exit "
+                        "threshold may fire (default 1)")
     p.add_argument("--fetch_dtype", default=None,
                    choices=["fp16", "bf16"],
                    help="half-precision device->host disparity fetch "

@@ -43,11 +43,14 @@ def build_service(args):
     # warmup_shapes stays out of the ServeConfig here: run_serve prewarms
     # AFTER build_observability wires the event log into the cost
     # registry, so the warmup compiles emit their "compile" run events.
+    tiers = tuple(t.strip() for t in (args.tiers or "").split(",")
+                  if t.strip())
     serve_cfg = ServeConfig(
         max_batch=args.max_batch,
         batch_sizes=tuple(int(s) for s in args.batch_sizes.split(",")),
         max_queue=args.max_queue,
         data_parallel=args.data_parallel, iters=args.valid_iters,
+        tiers=tiers, default_tier=args.default_tier,
         shape_bucket=args.shape_bucket,
         adaptive_buckets=args.adaptive_buckets,
         max_padding_waste=args.max_padding_waste,
@@ -119,10 +122,12 @@ def run_serve(args) -> int:
             signal.signal(sig, _graceful)
 
     log.info("serving on %s (batch sizes %s, queue<=%d, %d device "
-             "worker(s), %s buckets)", server.url,
+             "worker(s), %s buckets, tiers %s)", server.url,
              service.queue.sizes, service.serve_cfg.max_queue,
              len(service.devices),
-             "adaptive" if service.policy.adaptive else "static")
+             "adaptive" if service.policy.adaptive else "static",
+             (f"{sorted(service.tiers)} default={service.default_tier}"
+              if service.tiers else "off"))
     try:
         server.serve_forever()
     finally:
@@ -150,7 +155,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8551)
     p.add_argument("--valid_iters", type=int, default=32,
-                   help="GRU iterations per request")
+                   help="GRU iterations per request (the depth CAP for "
+                        "early-exit tiers)")
+    p.add_argument("--tiers", default="interactive,balanced,quality",
+                   help="comma list of latency tiers to serve: preset "
+                        "names (interactive: exit once the mean "
+                        "|Δdisparity| update < 0.05 px, min 2 iters; "
+                        "balanced: < 0.01 px, min 3; quality: the fixed-"
+                        "depth reference program) and/or inline "
+                        "'name:threshold_px[:min_iters]' specs.  Each "
+                        "tier compiles its own bucket executables "
+                        "(prewarm covers all of them) and requests pick "
+                        "one via ?tier= or X-Tier; responses carry "
+                        "X-Iters-Used.  Empty string disables tiers "
+                        "(every request runs the fixed-depth program)")
+    p.add_argument("--default_tier", default=None,
+                   help="tier for requests that name none (default: "
+                        "quality when configured, else the first tier) — "
+                        "the out-of-the-box path stays the reference "
+                        "fixed-depth program")
     p.add_argument("--max_batch", type=int, default=8,
                    help="occupancy ceiling per device dispatch")
     p.add_argument("--batch_sizes", default="1,2,4,8",

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Union
 
 CORR_BACKENDS = ("reg", "alt", "reg_fused")
 
@@ -156,6 +156,27 @@ class RaftStereoConfig:
     # only).  None = derive from device HBM and image width at trace time
     # (models/banded.default_band_rows); must be even (stride-2 alignment).
     band_rows: Optional[int] = None
+    # --- Adaptive GRU early exit (test-mode inference only) -------------
+    # The GRU refinement loop is ~89% of realtime inference
+    # (INFERENCE_PROFILE_r03.json) and the paper's iterative-refinement
+    # framing makes every intermediate disparity a valid output, so the
+    # test-mode loop can stop once the update stalls.  When
+    # ``exit_threshold_px > 0`` the fixed-depth ``lax.scan`` becomes a
+    # convergence-gated ``lax.while_loop``: each iteration computes the
+    # per-image mean |Δdisparity| (px at 1/2^n_downsample resolution — the
+    # same quantity TrainConfig.gru_telemetry measures) and the loop exits
+    # once the WORST batch member (max over the batch axis, so one
+    # executable serves the whole bucket) falls below the threshold,
+    # subject to the min/max bounds below.  The forward then returns an
+    # extra ``iters_used`` scalar.  <= 0 (default) keeps today's scan
+    # program bitwise-unchanged.  Train mode and unroll_gru ignore it.
+    exit_threshold_px: float = 0.0
+    # Iterations that always run before the threshold may fire (a
+    # too-early exit sees the large first updates as "converged-from-
+    # zero"); clamped to the effective depth.
+    exit_min_iters: int = 1
+    # Hard cap on the loop depth; None = the caller's ``iters`` argument.
+    exit_max_iters: Optional[int] = None
 
     def __post_init__(self):
         if self.context_dims is None:
@@ -206,6 +227,19 @@ class RaftStereoConfig:
                 f"rows_gru_halo={self.rows_gru_halo} must be a multiple of "
                 f"4, >= 8 (GRU pyramid alignment; see "
                 f"parallel/rows_gru.default_gru_halo)")
+        if self.exit_min_iters < 1:
+            raise ValueError(
+                f"exit_min_iters={self.exit_min_iters} must be >= 1")
+        if (self.exit_max_iters is not None
+                and self.exit_max_iters < self.exit_min_iters):
+            raise ValueError(
+                f"exit_max_iters={self.exit_max_iters} must be >= "
+                f"exit_min_iters={self.exit_min_iters}")
+        if self.exit_threshold_px > 0 and self.rows_gru:
+            raise ValueError(
+                "exit_threshold_px > 0 (adaptive early exit) is "
+                "unsupported with rows_gru: the row-sharded loop executor "
+                "runs a fixed-depth program (parallel/rows_gru.py)")
         if self.corr_w2_shards > 1 and self.corr_backend == "alt":
             raise ValueError(
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "
@@ -261,6 +295,74 @@ class RaftStereoConfig:
         return cls(shared_backbone=True, n_downsample=3, n_gru_layers=2,
                    slow_fast_gru=True, corr_backend="alt",
                    mixed_precision=True)
+
+
+# ------------------------------------------------------------ request tiers
+@dataclasses.dataclass(frozen=True)
+class RequestTier:
+    """A named accuracy/latency point on the early-exit knob.
+
+    A tier is just a preset of (exit_threshold_px, min_iters): the serving
+    engine compiles one executable family per tier
+    (serving/engine.py), the HTTP front door selects one per request, and
+    the CLIs accept the raw knobs directly.  ``exit_threshold_px <= 0``
+    means the tier runs the fixed-depth scan program (full quality,
+    bitwise-identical to the pre-early-exit path)."""
+
+    name: str
+    exit_threshold_px: float
+    min_iters: int = 1
+
+    def apply(self, cfg: RaftStereoConfig) -> RaftStereoConfig:
+        """The model config this tier's requests compile: the base
+        architecture with the early-exit knobs swapped in."""
+        return dataclasses.replace(
+            cfg, exit_threshold_px=self.exit_threshold_px,
+            exit_min_iters=self.min_iters, exit_max_iters=None)
+
+
+# Threshold units are px of mean |Δdisparity| per iteration at feature
+# resolution.  Defaults sit on the measured convergence curve
+# (train_gru_delta_px telemetry; swept on the four validators by
+# tools/early_exit_report.py -> EARLY_EXIT_r12.json): "interactive" trades
+# ~hundredths of a px of EPE for the biggest latency cut, "balanced"
+# stops once updates are metric-noise, "quality" is the reference
+# fixed-depth program.
+REQUEST_TIERS: Dict[str, RequestTier] = {
+    "interactive": RequestTier("interactive", exit_threshold_px=0.05,
+                               min_iters=2),
+    "balanced": RequestTier("balanced", exit_threshold_px=0.01,
+                            min_iters=3),
+    "quality": RequestTier("quality", exit_threshold_px=0.0, min_iters=1),
+}
+
+
+def parse_tier(spec: Union[str, RequestTier]) -> RequestTier:
+    """A tier from a preset name or an inline ``name:threshold[:min]``
+    spec — ``"interactive"`` uses the preset, ``"fast:0.1:2"`` defines an
+    ad-hoc tier (bench/smoke harnesses pin exact knobs this way)."""
+    if isinstance(spec, RequestTier):
+        return spec
+    parts = str(spec).split(":")
+    if len(parts) == 1:
+        tier = REQUEST_TIERS.get(parts[0])
+        if tier is None:
+            raise ValueError(
+                f"unknown tier {parts[0]!r}: use one of "
+                f"{sorted(REQUEST_TIERS)} or an inline "
+                f"'name:threshold_px[:min_iters]' spec")
+        return tier
+    if len(parts) not in (2, 3) or not parts[0]:
+        raise ValueError(f"tier spec {spec!r}: expected "
+                         f"'name:threshold_px[:min_iters]'")
+    try:
+        threshold = float(parts[1])
+        min_iters = int(parts[2]) if len(parts) == 3 else 1
+    except ValueError as e:
+        raise ValueError(f"tier spec {spec!r}: expected "
+                         f"'name:threshold_px[:min_iters]'") from e
+    return RequestTier(parts[0], exit_threshold_px=threshold,
+                       min_iters=min_iters)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -62,6 +62,13 @@ def effective_inference_config(config: RaftStereoConfig, iters: int,
     return config
 
 
+def early_exit_enabled(config: RaftStereoConfig) -> bool:
+    """Whether ``make_forward`` programs for this config return the extra
+    ``iters_used`` scalar (the convergence-gated while-loop path,
+    models/raft_stereo.py)."""
+    return config.exit_threshold_px > 0
+
+
 def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
                  donate_images: bool = True):
     """The one jitted inference program both the solo runner and the
@@ -69,20 +76,30 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     optional half-precision fetch cast.  Built here so the two paths share
     one jaxpr by construction (the serving parity contract).
 
+    With ``model.config.exit_threshold_px > 0`` the program returns
+    ``(flow_up, iters_used)`` — the convergence-gated while-loop's actual
+    trip count rides the fetch as one extra int32 scalar; otherwise the
+    return is the flow alone and the program is bitwise-identical to the
+    pre-early-exit build (``early_exit_enabled`` tells callers which
+    contract they compiled).
+
     ``donate_images`` marks the image arguments donated
     (``donate_argnums``): both call sites upload fresh per-call device
     buffers, so the runtime is free to reclaim or alias them the moment
     the program consumes them.  Donation never changes numerics (tested)
     and the module-level filter above silences XLA's not-usable note for
     output shapes that cannot alias."""
+    adaptive = early_exit_enabled(model.config)
+
     def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
         img1 = images1.astype(jnp.float32)
         img2 = images2.astype(jnp.float32)
-        _, flow_up = model.apply(variables, img1, img2, iters=iters,
-                                 test_mode=True)
+        out = model.apply(variables, img1, img2, iters=iters,
+                          test_mode=True)
+        flow_up = out[1]
         if fetch_dtype is not None:
             flow_up = flow_up.astype(fetch_dtype)
-        return flow_up
+        return (flow_up, out[2]) if adaptive else flow_up
 
     return jax.jit(fwd, donate_argnums=(1, 2) if donate_images else ())
 
@@ -101,7 +118,9 @@ class InferenceRunner:
                  corr_fp32_auto: bool = True,
                  fetch_dtype: Optional[str] = None,
                  cost_registry=None, cost_site: str = "eval",
-                 donate_images: bool = True):
+                 donate_images: bool = True,
+                 exit_threshold_px: Optional[float] = None,
+                 exit_min_iters: Optional[int] = None):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -131,7 +150,14 @@ class InferenceRunner:
         the worst ulp is 0.125 px at the far end and the mean rounding
         error is ~ulp/4, far below metric noise; bf16's 8-bit mantissa
         would round 190 px to ±0.75 px.  Results are returned as float32
-        regardless."""
+        regardless.
+        ``exit_threshold_px`` / ``exit_min_iters`` (None = the config's
+        own knobs): adaptive GRU early exit — with a threshold > 0 the
+        test-mode loop stops once the mean |Δdisparity| stalls
+        (config.py), ``iters`` becomes the depth CAP, and every call
+        records its actual trip count (``last_iters_used`` /
+        ``iters_used_mean()``).  The default keeps the fixed-depth scan
+        program bitwise-unchanged."""
         if shape_bucket is not None and shape_bucket % divis_by:
             raise ValueError(f"shape_bucket={shape_bucket} must be a "
                              f"multiple of the model's /{divis_by} "
@@ -143,8 +169,23 @@ class InferenceRunner:
         # against their own (eval.validate.make_validation_fn re-creates the
         # runner on mismatch); the guard's flip lives in effective_config.
         self.config = config
+        if exit_threshold_px is not None or exit_min_iters is not None:
+            config = dataclasses.replace(
+                config,
+                exit_threshold_px=(config.exit_threshold_px
+                                   if exit_threshold_px is None
+                                   else exit_threshold_px),
+                exit_min_iters=(config.exit_min_iters
+                                if exit_min_iters is None
+                                else exit_min_iters))
         self.effective_config = effective_inference_config(
             config, iters, corr_fp32_auto)
+        self.early_exit = early_exit_enabled(self.effective_config)
+        # Per-call trip-count accounting (early exit only): the CLIs print
+        # it and tools/early_exit_report.py averages it per validator.
+        self.last_iters_used: Optional[int] = None
+        self._iters_used_sum = 0
+        self._iters_used_calls = 0
         self.variables = variables
         self.iters = iters
         self.divis_by = shape_bucket or divis_by
@@ -217,6 +258,26 @@ class InferenceRunner:
             self._compiled[key] = self._compiled.pop(key)
         return self._compiled[key]
 
+    # -------------------------------------------------- iters-used tracking
+    def _note_iters_used(self, iters_used) -> int:
+        used = int(iters_used)
+        self.last_iters_used = used
+        self._iters_used_sum += used
+        self._iters_used_calls += 1
+        return used
+
+    def iters_used_mean(self) -> Optional[float]:
+        """Mean GRU trip count over the calls since the last reset; None
+        without early exit (the fixed path always runs ``iters``)."""
+        if not self._iters_used_calls:
+            return None
+        return self._iters_used_sum / self._iters_used_calls
+
+    def reset_iters_used(self) -> None:
+        self.last_iters_used = None
+        self._iters_used_sum = 0
+        self._iters_used_calls = 0
+
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
                  ) -> Tuple[np.ndarray, float]:
         """Returns ``(flow, seconds)`` — flow is (H, W) x-flow (=-disparity),
@@ -243,8 +304,12 @@ class InferenceRunner:
         p1 = np.pad(np.asarray(image1), spec, mode="edge")
         p2 = np.pad(np.asarray(image2), spec, mode="edge")
         fwd = self._forward_for(p1.shape[:2])
-        flow_padded = np.asarray(fwd(self.variables, jnp.asarray(p1[None]),
-                                     jnp.asarray(p2[None])))[0]
+        out = fwd(self.variables, jnp.asarray(p1[None]),
+                  jnp.asarray(p2[None]))
+        if self.early_exit:
+            out, iters_used = out
+            self._note_iters_used(iters_used)
+        flow_padded = np.asarray(out)[0]
         flow = padder.unpad(flow_padded[None])[0]  # pure NumPy slicing
         if flow.dtype != np.float32:               # half-precision fetch
             flow = flow.astype(np.float32)
@@ -277,8 +342,11 @@ class InferenceRunner:
         p1 = np.pad(np.stack(images1), spec, mode="edge")
         p2 = np.pad(np.stack(images2), spec, mode="edge")
         fwd = self._forward_for(p1.shape[1:3], batch=len(images1))
-        flows_padded = np.asarray(fwd(self.variables, jnp.asarray(p1),
-                                      jnp.asarray(p2)))
+        out = fwd(self.variables, jnp.asarray(p1), jnp.asarray(p2))
+        if self.early_exit:
+            out, iters_used = out
+            self._note_iters_used(iters_used)
+        flows_padded = np.asarray(out)
         flows = padder.unpad(flows_padded)
         if flows.dtype != np.float32:              # half-precision fetch
             flows = flows.astype(np.float32)

@@ -112,6 +112,14 @@ class RAFTStereo(nn.Module):
           test_mode: if True return ``(flow_low, flow_up)`` like the reference
             (core/raft_stereo.py:138-139); else the per-iteration list of
             full-resolution x-flow predictions, shape (iters, B, H, W).
+            With ``config.exit_threshold_px > 0`` the test-mode loop is
+            convergence-gated (``lax.while_loop``): it exits once the
+            worst batch member's mean |Δdisparity| falls below the
+            threshold, bounded by ``exit_min_iters`` and
+            ``min(iters, exit_max_iters)``, and the return grows a third
+            element — ``(flow_low, flow_up, iters_used)`` with
+            ``iters_used`` an int32 scalar.  Threshold <= 0 keeps this
+            fixed-depth scan program bitwise-unchanged.
           unroll_gru: test-mode only — run the refinement loop as an
             unrolled Python loop instead of ``lax.scan``.  Same math, same
             weights; the compiled program inlines every iteration, which is
@@ -297,6 +305,49 @@ class RAFTStereo(nn.Module):
                 net_list, disp, mask = gru_step(self, net_list, disp)
             flow_up = self._upsample(disp, mask)
             return disp, flow_up
+
+        if (test_mode and cfg.exit_threshold_px > 0
+                and not self.is_initializing()):
+            # Convergence-gated refinement: the scan becomes a
+            # ``lax.while_loop`` that computes each iteration's mean
+            # |Δdisparity| per image (the quantity gru_telemetry measures)
+            # and exits once the WORST batch member falls below the
+            # threshold — max-over-batch keeps one executable per bucket;
+            # an easy frame sharing a batch with a hard one simply rides
+            # to the hard frame's depth.  ``is_initializing`` falls
+            # through to the scan below: nn.while_loop cannot create
+            # variables in its body, and init only needs the parameter
+            # tree, which both loops build identically.
+            limit = (iters if cfg.exit_max_iters is None
+                     else min(iters, cfg.exit_max_iters))
+            min_iters = max(1, min(cfg.exit_min_iters, limit))
+            threshold = jnp.float32(cfg.exit_threshold_px)
+
+            def cond_exit(module, carry):
+                _net, _disp, _mask, it, delta = carry
+                return jnp.logical_or(
+                    it < min_iters,
+                    jnp.logical_and(it < limit, delta >= threshold))
+
+            def body_exit(module, carry):
+                net_list, disp, _mask, it, _delta = carry
+                net_list, new_disp, up_mask = gru_step(module,
+                                                       list(net_list), disp)
+                # Mean update magnitude per image, worst over the batch.
+                # Feeds only the loop predicate — the disparity chain is
+                # the same op sequence the fixed-depth scan runs.
+                delta = jnp.max(jnp.mean(jnp.abs(new_disp - disp),
+                                         axis=(1, 2)))
+                return (tuple(net_list), new_disp, up_mask,
+                        it + jnp.int32(1), delta)
+
+            mask0 = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+            carry = (tuple(net_list), disp, mask0, jnp.int32(0),
+                     jnp.float32(jnp.inf))
+            (net_fin, disp_fin, mask_fin, iters_used, _delta) = (
+                nn.while_loop(cond_exit, body_exit, self, carry))
+            flow_up = self._upsample(disp_fin, mask_fin)
+            return disp_fin, flow_up, iters_used
 
         if test_mode:
             # No per-iteration outputs needed; the scan carries state (plus

@@ -56,6 +56,9 @@ class DeadlineExceeded(RuntimeError):
 class Request:
     """One queued stereo pair.  ``payload`` is opaque to the queue (the
     engine stores images + padder there); ``bucket`` keys compatibility.
+    ``tier`` extends the compatibility key: requests of different latency
+    tiers run different compiled programs (per-tier early-exit knobs,
+    serving/engine.py), so they never share a dispatch batch.
     ``trace``/``queue_span`` are likewise opaque (telemetry/spans.py
     handles of a sampled request — the engine opens/closes them; the
     queue only carries them across its threads)."""
@@ -65,11 +68,17 @@ class Request:
     future: Future
     t_enqueue: float
     deadline: Optional[float] = None  # absolute monotonic seconds
+    tier: Optional[str] = None
     trace: Optional[object] = None
     queue_span: Optional[object] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
+
+    @property
+    def group_key(self) -> Tuple:
+        """What batches together: same padded bucket AND same tier."""
+        return (self.bucket, self.tier)
 
 
 def pick_batch_size(depth: int, sizes: Sequence[int]) -> int:
@@ -133,9 +142,10 @@ class BucketQueue:
         self.metrics = metrics or ServingMetrics(max_batch=max_batch)
         self._clock = clock
         self._cond = threading.Condition()
-        # bucket -> FIFO of requests; the pop scan picks the bucket whose
-        # head request has waited longest (global FIFO across buckets).
-        self._buckets: Dict[Tuple[int, int], List[Request]] = {}
+        # (bucket, tier) -> FIFO of requests; the pop scan picks the group
+        # whose head request has waited longest (global FIFO across
+        # groups).
+        self._buckets: Dict[Tuple, List[Request]] = {}
         self._depth = 0
         self._draining = False
         self._closed = False
@@ -163,14 +173,14 @@ class BucketQueue:
                 raise Overloaded(
                     f"queue full ({self._depth}/{self.max_queue} requests "
                     f"waiting); retry later")
-            self._buckets.setdefault(req.bucket, []).append(req)
+            self._buckets.setdefault(req.group_key, []).append(req)
             self._depth += 1
             self.metrics.admitted.inc()
             self.metrics.queue_depth.set(self._depth)
             self._cond.notify()
 
     # ----------------------------------------------------------------- pop
-    def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
+    def _oldest_bucket(self) -> Optional[Tuple]:
         key, oldest = None, None
         for k, reqs in self._buckets.items():
             if reqs and (oldest is None or reqs[0].t_enqueue < oldest):

@@ -51,8 +51,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_stereo_tpu import profiling
-from raft_stereo_tpu.config import RaftStereoConfig
-from raft_stereo_tpu.eval.runner import (effective_inference_config,
+from raft_stereo_tpu.config import (RaftStereoConfig, RequestTier,
+                                    parse_tier)
+from raft_stereo_tpu.eval.runner import (early_exit_enabled,
+                                         effective_inference_config,
                                          make_forward)
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 from raft_stereo_tpu.ops.padding import InputPadder
@@ -83,7 +85,19 @@ class ServeConfig:
     max_wait_ms: float = 0.0
     max_queue: int = 64          # admission bound; beyond it -> Overloaded
     data_parallel: int = 1       # device workers (<= local device count)
-    iters: int = 32              # GRU iterations per request
+    iters: int = 32              # GRU iterations per request (the depth
+    #                              CAP for early-exit tiers)
+    # Named latency tiers (config.py REQUEST_TIERS / inline
+    # "name:threshold_px[:min_iters]" specs): each tier is an early-exit
+    # knob setting the engine compiles a SEPARATE bucket-executable family
+    # for, and requests select one by name (HTTP ?tier= / X-Tier).  A tier
+    # whose threshold is <= 0 ("quality") runs the fixed-depth program and
+    # shares the base executables — the bitwise-parity bucket.  Empty
+    # (default): no tiers, exactly the pre-tier engine.
+    tiers: Tuple[str, ...] = ()
+    # Tier for requests that name none; None = "quality" when configured,
+    # else the first tier.  Ignored without tiers.
+    default_tier: Optional[str] = None
     shape_bucket: Optional[int] = None   # static coarser-than-/32 pad grid
     # Waste-driven spatial bucket selection: start shapes at the coarsest
     # grid in bucket_grids and refine a bucket toward the /32 floor once
@@ -147,6 +161,17 @@ class ServeConfig:
                 raise ValueError(
                     f"bucket_grids={self.bucket_grids}: every grid must be "
                     f"a multiple of /{MODEL_DIVIS}")
+        parsed = tuple(parse_tier(s) for s in self.tiers)  # raises on bad
+        names = [t.name for t in parsed]
+        if len(set(names)) != len(names):
+            raise ValueError(f"tiers={self.tiers}: duplicate tier names")
+        if self.default_tier is not None and self.default_tier not in names:
+            raise ValueError(
+                f"default_tier={self.default_tier!r} is not one of the "
+                f"configured tiers {names}")
+
+    def parsed_tiers(self) -> Tuple[RequestTier, ...]:
+        return tuple(parse_tier(s) for s in self.tiers)
 
 
 @dataclasses.dataclass
@@ -160,6 +185,10 @@ class ServeResult:
     fetch_s: float               # device->host result transfer
     total_s: float               # admission -> result ready
     batch_size: int              # occupancy of the dispatch it rode in
+    iters_used: Optional[int] = None  # GRU trip count of the dispatch
+    #                              (the worst batch member's depth; the
+    #                              configured depth on fixed-iters paths)
+    tier: Optional[str] = None   # latency tier the request ran at
 
     @property
     def disparity(self) -> np.ndarray:
@@ -330,6 +359,27 @@ class ServingEngine:
         self.effective_config = effective_inference_config(
             config, serve_cfg.iters)
         self.model = RAFTStereo(self.effective_config)
+        # Latency tiers: one effective config / model per tier (the
+        # early-exit knobs swapped into the SAME architecture — the
+        # parameter tree is shared, only the compiled loop differs).  A
+        # tier whose effective config equals the base one (threshold <= 0,
+        # e.g. "quality") maps to the base model so its requests share the
+        # base executables — the bitwise-parity bucket stays one program.
+        self.tiers: Dict[str, RequestTier] = {
+            t.name: t for t in serve_cfg.parsed_tiers()}
+        self.default_tier: Optional[str] = None
+        if self.tiers:
+            self.default_tier = serve_cfg.default_tier or (
+                "quality" if "quality" in self.tiers
+                else next(iter(self.tiers)))
+        self._tier_models: Dict[Optional[str], RAFTStereo] = {
+            None: self.model}
+        for name, tier in self.tiers.items():
+            eff = effective_inference_config(tier.apply(config),
+                                             serve_cfg.iters)
+            self._tier_models[name] = (
+                self.model if eff == self.effective_config
+                else RAFTStereo(eff))
         # Per-worker resident variables + the engine-owned executable
         # cache: (worker, padded shape, batch size) -> compiled forward,
         # bounded per worker, oldest evicted.
@@ -364,15 +414,33 @@ class ServingEngine:
         """The padded (Hp, Wp) this image shape dispatches at."""
         return self.policy.bucket_for(shape[0], shape[1])[:2]
 
+    def resolve_tier(self, tier: Optional[str]) -> Optional[str]:
+        """The tier a request actually runs at: the named one (validated),
+        or the default tier when tiers are configured, or None (the base
+        fixed-depth path) when they are not."""
+        if tier is None:
+            return self.default_tier
+        if tier not in self.tiers:
+            raise ValueError(
+                f"unknown tier {tier!r}: this engine serves "
+                f"{sorted(self.tiers) or '(no tiers configured)'}")
+        return tier
+
     def submit(self, left: np.ndarray, right: np.ndarray,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               tier: Optional[str] = None) -> Future:
         """Admit one stereo pair; returns a Future of ``ServeResult``.
 
-        Raises ``Overloaded`` at the door when the queue is full or the
-        engine is draining; the Future fails with ``DeadlineExceeded`` if
-        the request's deadline passes before a device picks it up.
+        ``tier`` selects a configured latency tier (``ServeConfig.tiers``)
+        — requests of different tiers run different compiled programs and
+        never share a dispatch; None runs the default tier (or the base
+        fixed-depth path when no tiers are configured).  Raises
+        ``Overloaded`` at the door when the queue is full or the engine is
+        draining; the Future fails with ``DeadlineExceeded`` if the
+        request's deadline passes before a device picks it up.
         """
         t_admit = time.perf_counter()
+        tier = self.resolve_tier(tier)
         left, right = np.asarray(left), np.asarray(right)
         if left.ndim != 3 or left.shape != right.shape:
             raise ValueError(
@@ -389,7 +457,7 @@ class ServingEngine:
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.serve_cfg.default_deadline_ms)
         req = Request(bucket=(hp, wp), payload=payload,
-                      future=Future(), t_enqueue=now,
+                      future=Future(), t_enqueue=now, tier=tier,
                       deadline=(None if deadline_ms is None
                                 else now + deadline_ms / 1e3))
         # Sampled request: root span + admission (validate/pad) span; the
@@ -397,7 +465,8 @@ class ServingEngine:
         # or in the done-callback for requests dropped in the queue.
         trace = self.tracer.start_trace(
             "serve.request", bucket=str(req.bucket),
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms,
+            **({"tier": tier} if tier is not None else {}))
         if trace is not None:
             req.trace = trace
             self.tracer.add_span("serve.admission", trace,
@@ -432,42 +501,58 @@ class ServingEngine:
 
     def infer(self, left: np.ndarray, right: np.ndarray,
               deadline_ms: Optional[float] = None,
-              timeout: Optional[float] = None) -> ServeResult:
+              timeout: Optional[float] = None,
+              tier: Optional[str] = None) -> ServeResult:
         """Blocking convenience: submit + wait (the in-process client)."""
-        return self.submit(left, right, deadline_ms).result(timeout=timeout)
+        return self.submit(left, right, deadline_ms,
+                           tier=tier).result(timeout=timeout)
 
     # --------------------------------------------------------- compile cache
-    def _cost_key(self, bucket: Tuple[int, int], batch: int) -> str:
+    def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
+        """The executable-cache key a tier compiles under: None when the
+        tier's model IS the base model (fixed-depth tiers share the base
+        executables — one program, one cost record, bitwise parity)."""
+        if tier is None or self._tier_models.get(tier) is self.model:
+            return None
+        return tier
+
+    def _cost_key(self, bucket: Tuple[int, int], batch: int,
+                  tier: Optional[str] = None) -> str:
         """Stable label of one compile point in the cost registry — what
         GET /debug/compiles lists and the MFU path looks up."""
-        return f"serving.forward({bucket[0]}x{bucket[1]},b{batch})"
+        tail = "" if self._cache_tier(tier) is None else f",tier={tier}"
+        return f"serving.forward({bucket[0]}x{bucket[1]},b{batch}{tail})"
 
-    def compiled_cost(self, bucket: Tuple[int, int], batch: int = 1):
+    def compiled_cost(self, bucket: Tuple[int, int], batch: int = 1,
+                      tier: Optional[str] = None):
         """The cost record for a compiled (bucket, batch) executable, or
         None (no registry / not compiled yet / analysis degraded)."""
         if self.costs is None:
             return None
-        return self.costs.get(self._cost_key(bucket, batch))
+        return self.costs.get(self._cost_key(bucket, batch, tier))
 
     def _forward_for(self, bucket: Tuple[int, int], batch: int = 1,
-                     worker: int = 0):
+                     worker: int = 0, tier: Optional[str] = None):
         """The compiled batch-``batch`` executable for ``bucket`` on
         ``worker``'s device — the engine-owned cache the round-6 design
         spread across per-worker InferenceRunners.  Bounded per worker at
-        ``max_cached_shapes`` (bucket, batch) entries, oldest evicted."""
-        key = (worker, tuple(bucket), batch)
+        ``max_cached_shapes`` (bucket, batch, tier) entries, oldest
+        evicted."""
+        tier = self._cache_tier(tier)
+        key = (worker, tuple(bucket), batch, tier)
         with self._cache_lock:
             if key in self._compiled:
                 self._compiled[key] = self._compiled.pop(key)  # LRU refresh
                 return self._compiled[key]
         # Build + (with cost telemetry) AOT-instrument outside the lock —
         # distinct keys may compile concurrently on different workers.
-        fwd = make_forward(self.model, self.serve_cfg.iters,
+        fwd = make_forward(self._tier_models[tier], self.serve_cfg.iters,
                            self._fetch_jax_dtype(),
                            donate_images=self.serve_cfg.donate_buffers)
         if self.costs is not None:
             fwd = self.costs.instrument(
-                fwd, key=self._cost_key(bucket, batch), site="serving")
+                fwd, key=self._cost_key(bucket, batch, tier),
+                site="serving")
         with self._cache_lock:
             mine = [k for k in self._compiled if k[0] == worker]
             while len(mine) >= self.serve_cfg.max_cached_shapes:
@@ -475,13 +560,15 @@ class ServingEngine:
                 self._compiled.pop(evicted)
                 log.info(
                     "engine compile cache full (max_cached_shapes=%d): "
-                    "evicting oldest executable for bucket %s batch %d on "
-                    "worker %d — its next use re-pays XLA compile time",
+                    "evicting oldest executable for bucket %s batch %d "
+                    "tier %s on worker %d — its next use re-pays XLA "
+                    "compile time",
                     self.serve_cfg.max_cached_shapes, evicted[1],
-                    evicted[2], evicted[0])
+                    evicted[2], evicted[3], evicted[0])
                 if self.costs is not None:
                     self.costs.note_runner_eviction(
-                        self._cost_key(evicted[1], evicted[2]), len(mine))
+                        self._cost_key(evicted[1], evicted[2], evicted[3]),
+                        len(mine))
             self._compiled[key] = fwd
             if self.costs is not None:
                 self.costs.note_runner_cache_size(len(self._compiled))
@@ -498,27 +585,41 @@ class ServingEngine:
                 "bf16": jnp.bfloat16}[fetch]
 
     def prewarm(self, raw_hw: Tuple[int, int],
-                batch_sizes: Optional[Sequence[int]] = None) -> None:
+                batch_sizes: Optional[Sequence[int]] = None,
+                tiers: Optional[Sequence[Optional[str]]] = None) -> None:
         """Compile + warm the whole bucket ladder for one raw shape on
         every worker: each configured batch size dispatches once with
         zero images, so the first real requests at this shape hit warm
         executables (and, with cost telemetry, the registry holds every
-        ladder rung's cost record at boot)."""
+        ladder rung's cost record at boot).  With latency tiers
+        configured, every tier's executable family is warmed (fixed-depth
+        tiers share the base executables, so the ladder compiles once per
+        DISTINCT program, not once per tier name)."""
         import jax
 
         h, w = int(raw_hw[0]), int(raw_hw[1])
         hp, wp, _ = self.policy.bucket_for(h, w)
         sizes = tuple(batch_sizes) if batch_sizes else self.queue.sizes
+        if tiers is None:
+            tiers = tuple(self.tiers) if self.tiers else (None,)
+        # Distinct executable families only: "quality" and the base path
+        # normalize to the same cache key.
+        cache_tiers = sorted({self._cache_tier(t) for t in tiers},
+                             key=lambda t: (t is not None, t or ""))
         for widx, dev in enumerate(self.devices):
-            for n in sizes:
-                fwd = self._forward_for((hp, wp), n, worker=widx)
-                zeros = np.zeros((n, hp, wp, 3), np.uint8)
-                out = fwd(self._worker_vars[widx],
-                          jax.device_put(zeros, dev),
-                          jax.device_put(zeros.copy(), dev))
-                jax.block_until_ready(out)
-        log.info("prewarmed bucket %dx%d batch sizes %s on %d worker(s)",
-                 hp, wp, sizes, len(self.devices))
+            for tier in cache_tiers:
+                for n in sizes:
+                    fwd = self._forward_for((hp, wp), n, worker=widx,
+                                            tier=tier)
+                    zeros = np.zeros((n, hp, wp, 3), np.uint8)
+                    out = fwd(self._worker_vars[widx],
+                              jax.device_put(zeros, dev),
+                              jax.device_put(zeros.copy(), dev))
+                    jax.block_until_ready(out)
+        log.info("prewarmed bucket %dx%d batch sizes %s (%d executable "
+                 "famil%s) on %d worker(s)", hp, wp, sizes,
+                 len(cache_tiers), "y" if len(cache_tiers) == 1 else "ies",
+                 len(self.devices))
 
     # --------------------------------------------------------------- workers
     def _worker_loop(self, widx: int) -> None:
@@ -554,6 +655,7 @@ class ServingEngine:
         t_pickup = time.monotonic()
         waits = [t_pickup - r.t_enqueue for r in batch]
         bucket = batch[0].bucket
+        tier = batch[0].tier       # queue groups by (bucket, tier)
         n = len(batch)
 
         # Sampled requests: the queue leg ends at worker pickup; the
@@ -572,7 +674,9 @@ class ServingEngine:
             # compiles (make_forward), so that bucket stays bitwise-equal
             # to solo inference; n > 1 amortizes the fixed per-dispatch
             # work across a real batch axis with zero filler frames.
-            fwd = self._forward_for(bucket, n, worker=widx)
+            fwd = self._forward_for(bucket, n, worker=widx, tier=tier)
+            adaptive = early_exit_enabled(
+                self._tier_models[self._cache_tier(tier)].config)
             p1 = np.stack([r.payload.left for r in batch])
             p2 = np.stack([r.payload.right for r in batch])
             out = fwd(self._worker_vars[widx],
@@ -586,19 +690,33 @@ class ServingEngine:
         p_ready = time.perf_counter() if sampled else 0.0
 
         with profiling.annotate("serve.fetch"):
-            flows_padded = np.asarray(out)        # (n, Hp, Wp)
+            if adaptive:
+                flows, iters_used_dev = out
+                iters_used = int(iters_used_dev)  # one extra scalar fetch
+            else:
+                flows, iters_used = out, self.serve_cfg.iters
+            flows_padded = np.asarray(flows)      # (n, Hp, Wp)
         t_fetched = time.monotonic()
         p_fetched = time.perf_counter() if sampled else 0.0
         for r in sampled:
             self.tracer.add_span(
                 "serve.dispatch", r.trace, p_pickup, p_ready,
-                bucket=str(bucket), batch_size=n, device=str(device))
+                bucket=str(bucket), batch_size=n, device=str(device),
+                iters_used=iters_used,
+                **({"tier": tier} if tier is not None else {}))
             self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
                                  batch_size=n)
 
         device_s = t_ready - t_pickup
         fetch_s = t_fetched - t_ready
         self.metrics.observe_dispatch(n)
+        # Trip-count telemetry: every dispatch lands in the per-tier
+        # infer_gru_iters_used histogram (fixed-depth paths report the
+        # configured depth, so tier histograms are directly comparable)
+        # and early-exit dispatches accumulate the iterations they saved.
+        self.metrics.observe_iters_used(
+            tier or "default", iters_used, self.serve_cfg.iters,
+            n_requests=n)
         self.metrics.device_time.observe(device_s)
         self.metrics.fetch_time.observe(fetch_s)
         # Padding-waste accounting + the policy feedback loop: every
@@ -612,9 +730,14 @@ class ServingEngine:
         self.metrics.observe_padding(bucket, real_px, dispatched_px)
         self.policy.note(bucket, real_px, dispatched_px)
         # MFU numerator: the batch-n executable's model flops, once per
-        # dispatch.
+        # dispatch.  NOTE XLA's cost_analysis counts a loop body ONCE
+        # regardless of trip count (scan and while alike —
+        # tools/cost_report.py records both undercounts), so this
+        # numerator never overstates under early exit; scale phase flops
+        # by the observed iters_used for honest per-phase MFU
+        # (cost_report --observed_iters).
         if self._mfu is not None:
-            rec = self.compiled_cost(bucket, batch=n)
+            rec = self.compiled_cost(bucket, batch=n, tier=tier)
             if rec is not None and rec.flops:
                 self.metrics.dispatched_flops.inc(rec.flops)
                 self._mfu.note(rec.flops)
@@ -632,7 +755,7 @@ class ServingEngine:
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
-                batch_size=n))
+                batch_size=n, iters_used=iters_used, tier=tier))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

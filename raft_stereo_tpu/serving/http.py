@@ -9,7 +9,10 @@ Endpoints:
     - ``Content-Type: image/png``: ONE side-by-side pair (left|right
       concatenated along width; even width), the common packed layout for
       stereo capture streams.
-  Optional ``X-Deadline-Ms`` header bounds the queue wait.  Response
+  Optional ``X-Deadline-Ms`` header bounds the queue wait.  Optional
+  ``?tier=`` (or ``X-Tier`` header) selects a configured latency tier —
+  a named early-exit knob setting (``interactive`` / ``balanced`` /
+  ``quality``, serving/engine.py); unknown tiers get 400.  Response
   (``?format=``):
     - ``npy`` (default): raw ``.npy`` float32 positive-disparity map;
     - ``png``: 16-bit PNG, disparity*256 (the KITTI on-disk convention —
@@ -169,14 +172,20 @@ def make_handler(service: StereoService,
                 deadline_hdr = self.headers.get("X-Deadline-Ms")
                 deadline_ms: Optional[float] = (
                     float(deadline_hdr) if deadline_hdr else None)
-                fmt = parse_qs(url.query).get("format", ["npy"])[0]
+                query = parse_qs(url.query)
+                fmt = query.get("format", ["npy"])[0]
                 if fmt not in ("npy", "png"):
                     raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+                tier = query.get("tier", [None])[0] or \
+                    self.headers.get("X-Tier")
+                if tier is not None:
+                    service.resolve_tier(tier)  # 400 on unknown tiers
             except (ValueError, KeyError, OSError) as e:
                 self._reply_json(400, {"error": str(e)})
                 return
             try:
-                result = service.infer(left, right, deadline_ms=deadline_ms)
+                result = service.infer(left, right, deadline_ms=deadline_ms,
+                                       tier=tier)
             except Overloaded as e:
                 if e.draining:
                     self._reply_json(503, {"error": str(e)},
@@ -193,10 +202,15 @@ def make_handler(service: StereoService,
                 self._reply_json(500, {"error": str(e)})
                 return
             payload, ctype = _encode_disparity(result.disparity, fmt)
-            self._reply(200, payload, ctype, extra_headers=[
+            headers = [
                 ("X-Queue-Wait-Ms", f"{result.queue_wait_s * 1e3:.2f}"),
                 ("X-Device-Ms", f"{result.device_s * 1e3:.2f}"),
-                ("X-Batch-Size", str(result.batch_size))])
+                ("X-Batch-Size", str(result.batch_size))]
+            if result.iters_used is not None:
+                headers.append(("X-Iters-Used", str(result.iters_used)))
+            if result.tier is not None:
+                headers.append(("X-Tier", result.tier))
+            self._reply(200, payload, ctype, extra_headers=headers)
 
     return Handler
 

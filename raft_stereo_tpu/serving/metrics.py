@@ -28,6 +28,11 @@ __all__ = ["DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
 PADDING_WASTE_BUCKETS = (0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
                          0.3, 0.5, 0.75)
 
+# GRU-iteration buckets for infer_gru_iters_used: trip counts, not
+# seconds.  Covers the realtime depth (7), the accuracy depth (32), and
+# headroom past it.
+ITERS_USED_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+
 
 class ServingMetrics:
     """The serving subsystem's standard instrument set, in one place so the
@@ -116,6 +121,13 @@ class ServingMetrics:
             "without cost telemetry or with an unknown peak)")
         self._bucket_lock = threading.Lock()
         self._bucket_px: Dict[str, Tuple[Counter, Counter]] = {}
+        # Adaptive early-exit accounting (serving/engine.py per-tier
+        # executables): the per-tier trip-count histogram family
+        # infer_gru_iters_used{tier=...} and the iterations-saved counter
+        # family — (configured depth - iters_used) summed over every
+        # request, i.e. the GRU compute the convergence gate recovered.
+        self._iters_lock = threading.Lock()
+        self._iters_by_tier: Dict[str, Tuple[Histogram, Counter]] = {}
         self.last_batch_unix = r.gauge(
             "serve_last_batch_unix_seconds",
             "wall-clock time the last micro-batch finished (0 until one "
@@ -138,6 +150,37 @@ class ServingMetrics:
                     labels={"batch": str(batch_size)})
                 self._dispatch_by_size[batch_size] = c
         c.inc()
+
+    def observe_iters_used(self, tier: str, iters_used: int,
+                           max_iters: int, n_requests: int = 1) -> None:
+        """Record one dispatch's GRU trip count: the per-tier histogram
+        gets one observation per dispatch, the saved counter accumulates
+        (max_iters - iters_used) per REQUEST (the whole batch rode the
+        worst member's depth)."""
+        with self._iters_lock:
+            pair = self._iters_by_tier.get(tier)
+            if pair is None:
+                labels = {"tier": tier}
+                pair = (self.registry.histogram(
+                            "infer_gru_iters_used",
+                            "GRU iterations actually run per dispatch "
+                            "(convergence-gated early exit; fixed-depth "
+                            "tiers always report the configured depth)",
+                            buckets=ITERS_USED_BUCKETS, labels=labels),
+                        self.registry.counter(
+                            "serve_gru_iters_saved_total",
+                            "GRU iterations the early-exit gate skipped, "
+                            "summed over requests (configured depth - "
+                            "iters_used)", labels=labels))
+                self._iters_by_tier[tier] = pair
+        pair[0].observe(iters_used)
+        pair[1].inc(max(0, max_iters - iters_used) * max(1, n_requests))
+
+    def iters_used_stats(self, tier: str):
+        """(histogram, saved-counter) for one tier, or None before its
+        first dispatch — what the smoke/bench harnesses assert on."""
+        with self._iters_lock:
+            return self._iters_by_tier.get(tier)
 
     def dispatches_at(self, batch_size: int) -> int:
         """Dispatch count for one batch-size bucket (0 if never used)."""

@@ -12,6 +12,13 @@ reassociation tolerance; the batch-1 bucket's bitwise parity is pinned by
 the tier-1 tests) and that the cost registry holds a record per bucket
 ladder rung.
 
+Also smokes the adaptive early-exit tiers end to end (round 12): an easy
+low-texture request at the ``interactive`` tier must exit before the
+configured depth and report it in ``/metrics``
+(``infer_gru_iters_used{tier="interactive"}``), while the ``quality``
+tier runs the fixed-depth program to the cap — the result is written to
+``EARLY_EXIT_ci.json`` (set EARLY_EXIT_CI_OUT; CI uploads it).
+
 Writes a ``bench_record`` JSON (default ``BENCH_SERVE_smoke.json``; set
 SERVE_SMOKE_OUT to pin the path — CI uploads it as an artifact).  Exit 0
 on success, non-zero with a diagnostic on any failed assertion.
@@ -32,6 +39,73 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 
 OUT = os.environ.get("SERVE_SMOKE_OUT",
                      os.path.join(_REPO, "BENCH_SERVE_smoke.json"))
+EE_OUT = os.environ.get("EARLY_EXIT_CI_OUT",
+                        os.path.join(_REPO, "EARLY_EXIT_ci.json"))
+
+
+def early_exit_smoke(cfg, variables, hw, lefts, rights) -> dict:
+    """The adaptive-tier acceptance smoke: interactive exits early on an
+    easy request, quality runs the fixed program to the cap, both land in
+    /metrics.  Returns the record written to EARLY_EXIT_ci.json."""
+    import numpy as np
+
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.telemetry.events import bench_record
+
+    iters_cap = 4
+    # Inline tier spec, calibrated for the smoke's SEEDED init weights
+    # (everything here is deterministic: PRNGKey(0) init,
+    # default_rng(0) images): the low-texture pair's per-iteration mean
+    # |Δdisparity| sits at 5.4-6.2 px while the textured pair's runs
+    # 7.2-9.5 px, so a 7.0 px gate exits the easy request and runs the
+    # hard one to the cap — the discrimination the production presets'
+    # px-scale thresholds provide on trained weights
+    # (tools/early_exit_report.py).  min_iters=2 < cap, so the early
+    # exit is observable and distinct from the floor.
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=iters_cap,
+            cost_telemetry=True,
+            tiers=("interactive:7.0:2", "quality"))) as svc:
+        svc.prewarm(hw)
+        # Easy request: a low-texture synthetic pair (constant gray) has
+        # no correlation signal, so the GRU's updates stall immediately.
+        easy = np.full(hw + (3,), 127, np.uint8)
+        r_i = svc.infer(easy, easy.copy(), tier="interactive", timeout=300)
+        r_hard = svc.infer(lefts[0], rights[0], tier="interactive",
+                           timeout=300)
+        r_q = svc.infer(lefts[0], rights[0], tier="quality", timeout=300)
+        assert r_i.iters_used is not None and r_i.iters_used < iters_cap, (
+            f"interactive tier must exit before the cap on the easy "
+            f"request: iters_used={r_i.iters_used} cap={iters_cap}")
+        assert r_hard.iters_used == iters_cap, (
+            f"the textured request must run past the gate: "
+            f"iters_used={r_hard.iters_used} cap={iters_cap}")
+        assert r_q.iters_used == iters_cap, (
+            f"quality tier must run the fixed program to the cap: "
+            f"iters_used={r_q.iters_used} cap={iters_cap}")
+        # ... and /metrics must say so (the per-tier histogram family +
+        # the iterations-saved counter).
+        text = svc.metrics.render_text()
+        assert 'infer_gru_iters_used' in text, text[:500]
+        assert 'tier="interactive"' in text and 'tier="quality"' in text
+        hist, saved = svc.metrics.iters_used_stats("interactive")
+        assert hist.count >= 1
+        assert saved.value >= iters_cap - r_i.iters_used, (
+            saved.value, iters_cap, r_i.iters_used)
+        q_hist, q_saved = svc.metrics.iters_used_stats("quality")
+        assert q_saved.value == 0, "fixed-depth tier saved iterations?"
+        return bench_record({
+            "metric": "early_exit_ci_smoke",
+            "value": r_i.iters_used,
+            "unit": f"iters_used at interactive tier (cap {iters_cap}, "
+                    f"{hw[0]}x{hw[1]}, CPU)",
+            "interactive_iters_used": r_i.iters_used,
+            "interactive_hard_iters_used": r_hard.iters_used,
+            "quality_iters_used": r_q.iters_used,
+            "iters_cap": iters_cap,
+            "iters_saved_total": saved.value,
+            "tiers": ["interactive:7.0:2", "quality"],
+        })
 
 
 def main() -> int:
@@ -109,6 +183,11 @@ def main() -> int:
     print(json.dumps(rec))
     write_record(OUT, rec, indent=1)
     print(f"serve smoke OK -> {OUT}")
+
+    ee_rec = early_exit_smoke(cfg, variables, hw, lefts, rights)
+    print(json.dumps(ee_rec))
+    write_record(EE_OUT, ee_rec, indent=1)
+    print(f"early-exit smoke OK -> {EE_OUT}")
     return 0
 
 

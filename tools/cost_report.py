@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--iters", type=int, default=7,
                    help="GRU iterations of the reported executable "
                         "(realtime inference runs 7, eval 32)")
+    p.add_argument("--observed_iters", type=float, default=None,
+                   help="mean GRU trip count actually observed under "
+                        "adaptive early exit (infer_gru_iters_used / "
+                        "EARLY_EXIT_*.json).  Adds an 'effective' section "
+                        "scaling the gru_iter phase to the observed depth "
+                        "— the honest flops numerator for serve_mfu/"
+                        "train_mfu when the loop exits early (a fixed-"
+                        "depth numerator would overstate utilization)")
     p.add_argument("--tag", default=DEFAULT_TAG,
                    help="suffix of the default output file name")
     p.add_argument("--out", default=None,
@@ -151,6 +159,18 @@ def main(argv=None) -> int:
     # by the loop-body-once convention, memory analysis honest.
     deployed = aot_cost_summary(forward(args.iters, unroll=False),
                                 variables, img, img)
+    # The early-exit twin: the convergence-gated lax.while_loop program
+    # (models/raft_stereo.py).  Same undercount convention — XLA's
+    # cost_analysis counts the while body ONCE regardless of trip count —
+    # recorded next to the scan so both deployed-program flavors carry
+    # their undercount ratio explicitly.
+    import dataclasses as _dc
+    ee_model = RAFTStereo(_dc.replace(cfg, exit_threshold_px=0.01,
+                                      exit_min_iters=2))
+    early_exit = aot_cost_summary(
+        jax.jit(lambda v, a, c: ee_model.apply(
+            v, a, c, iters=args.iters, test_mode=True)[1]),
+        variables, img, img)
     per_iter = {k: ((full[k] - full_1[k]) / (args.iters - 1)
                     if full.get(k) is not None and full_1.get(k) is not None
                     else None) for k in _COST_KEYS}
@@ -232,6 +252,37 @@ def main(argv=None) -> int:
                     if model_flops else None),
     }
 
+    def _undercount(rec):
+        """deployed-program flops / honest unrolled flops — the factor by
+        which the loop-body-once convention undercounts this executable."""
+        if rec.get("flops") and model_flops:
+            return round(rec["flops"] / model_flops, 4)
+        return None
+
+    # Effective flops at an OBSERVED trip count: with adaptive early exit
+    # the gru_iter phase runs iters_used iterations, not the configured
+    # cap, so MFU numerators must scale with it or they overstate
+    # utilization exactly when the gate saves the most work.
+    effective = None
+    if args.observed_iters is not None:
+        per_it = per_iter.get("flops")
+        fixed_fl = fixed.get("flops")
+        if per_it is not None and fixed_fl is not None:
+            eff_flops = fixed_fl + per_it * args.observed_iters
+            effective = {
+                "observed_iters": args.observed_iters,
+                "configured_iters": args.iters,
+                "effective_model_flops": eff_flops,
+                "flops_scale_vs_configured": (
+                    round(eff_flops / model_flops, 4) if model_flops
+                    else None),
+                "note": "effective = fixed-phase flops + per-iteration "
+                        "flops x observed_iters; use as the serve_mfu/"
+                        "train_mfu numerator under early exit",
+            }
+            phases["gru_iter"]["flops_at_observed_iters"] = (
+                per_it * args.observed_iters)
+
     rec = {
         "metric": "cost_report",
         "config": args.config,
@@ -242,11 +293,22 @@ def main(argv=None) -> int:
         "whole_model_iters1": full_1,
         "deployed_scan_executable": dict(
             deployed,
+            undercount_vs_unrolled=_undercount(deployed),
             note="lax.scan while-loop body counted once by XLA "
                  "cost_analysis — use whole_model (unrolled) flops as "
                  "the denominator"),
+        "early_exit_while_executable": dict(
+            early_exit,
+            undercount_vs_unrolled=_undercount(early_exit),
+            note="convergence-gated lax.while_loop program "
+                 "(exit_threshold_px > 0): cost_analysis counts the body "
+                 "once regardless of trip count, same undercount as the "
+                 "scan — scale gru_iter flops by the OBSERVED iters_used "
+                 "(--observed_iters / infer_gru_iters_used) for honest "
+                 "MFU under early exit"),
         "phases": phases,
         "sum_check": sum_check,
+        "effective_at_observed_iters": effective,
         "roofline": {
             "peak_flops_per_s": peak_f,
             "peak_bytes_per_s": peak_b,
