@@ -36,15 +36,25 @@ def _parse_hw(text: str):
 
 
 def build_service(args):
-    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving import (ServeConfig, StereoService,
+                                         enable_persistent_compilation_cache,
+                                         parse_chaos_spec)
 
+    if args.executable_cache_dir:
+        # Before any compile: jax's own persistent compilation cache
+        # covers what the engine's AOT executable cache does not.
+        enable_persistent_compilation_cache(args.executable_cache_dir)
     cfg, variables = common.load_any_checkpoint(
         args.restore_ckpt, **common.arch_overrides(args))
-    # warmup_shapes stays out of the ServeConfig here: run_serve prewarms
-    # AFTER build_observability wires the event log into the cost
-    # registry, so the warmup compiles emit their "compile" run events.
+    # warmup_shapes declares the readiness target (/readyz gates on it)
+    # but prewarm_on_init=False defers the actual warm-up to run_serve:
+    # the compiles happen AFTER build_observability wires the event log
+    # into the cost registry (so they emit "compile" run events) and
+    # AFTER the HTTP server is up (so /readyz answers "warming").
     tiers = tuple(t.strip() for t in (args.tiers or "").split(",")
                   if t.strip())
+    exempt = tuple(t.strip() for t in (args.brownout_exempt or "").split(",")
+                   if t.strip())
     serve_cfg = ServeConfig(
         max_batch=args.max_batch,
         batch_sizes=tuple(int(s) for s in args.batch_sizes.split(",")),
@@ -58,7 +68,17 @@ def build_service(args):
         default_deadline_ms=args.deadline_ms,
         trace_sample_rate=args.trace_sample_rate,
         cost_telemetry=args.cost_telemetry,
-        device_peak_tflops=args.device_peak_tflops)
+        device_peak_tflops=args.device_peak_tflops,
+        chaos=parse_chaos_spec(args.chaos),
+        max_dispatch_attempts=args.max_dispatch_attempts,
+        retry_backoff_ms=args.retry_backoff_ms,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        brownout=args.brownout,
+        brownout_exempt_tiers=exempt,
+        executable_cache_dir=args.executable_cache_dir,
+        warmup_shapes=tuple(args.warmup_shape or ()),
+        prewarm_on_init=False)
     return StereoService(cfg, variables, serve_cfg)
 
 
@@ -79,11 +99,16 @@ def build_observability(args, service):
         if events is not None:
             events.add_sink(recorder.record_event)
     watchdog = None
-    if args.watchdog:
+    if args.watchdog or events is not None or recorder is not None:
+        # The engine's resilience transitions (worker crashes, circuit
+        # state changes, brownout levels, poisoned requests) report
+        # through the same sink path as the watchdog alarms.
         sink = AnomalySink(events=events, recorder=recorder,
                            counter=service.metrics.anomalies)
-        watchdog = ServingWatchdog(sink, service.metrics,
-                                   max_queue=args.max_queue).start()
+        service.attach_anomaly_sink(sink)
+        if args.watchdog:
+            watchdog = ServingWatchdog(sink, service.metrics,
+                                       max_queue=args.max_queue).start()
     if events is not None and service.costs is not None:
         # First compile of each bucket becomes a "compile" run event with
         # its cost summary — the serving twin of the training compile
@@ -95,14 +120,27 @@ def build_observability(args, service):
 def run_serve(args) -> int:
     from raft_stereo_tpu.serving.http import StereoHTTPServer
 
+    import time
+
     service = build_service(args)
     events, recorder, watchdog = build_observability(args, service)
+    # The HTTP server comes up BEFORE prewarm: /healthz (liveness) and
+    # /readyz (readiness, 503 while warming) answer during the warm-up,
+    # so an orchestrator can tell "booting" from "dead" — the
+    # liveness/readiness split this round introduced.
+    server = StereoHTTPServer(service, host=args.host, port=args.port,
+                              recorder=recorder).start()
+    t_warm = time.perf_counter()
     for hw in (args.warmup_shape or ()):
         # After the event log is wired: each ladder compile lands in the
         # cost registry AND the run-event timeline.
         service.prewarm(hw)
-    server = StereoHTTPServer(service, host=args.host, port=args.port,
-                              recorder=recorder)
+    if args.warmup_shape:
+        log.info("prewarm done in %.1fs (%d cold compiles, %d restored "
+                 "from the persistent cache); /readyz now reports ready",
+                 time.perf_counter() - t_warm,
+                 service.metrics.compiles_cold.value,
+                 service.metrics.compiles_warm.value)
     stop = threading.Event()
     forced = threading.Event()
 
@@ -129,7 +167,11 @@ def run_serve(args) -> int:
              (f"{sorted(service.tiers)} default={service.default_tier}"
               if service.tiers else "off"))
     try:
-        server.serve_forever()
+        # serve_forever already runs on the server thread (started above
+        # so readiness answered during prewarm); park the main thread on
+        # a signal-friendly join.
+        while server._thread.is_alive():
+            server._thread.join(timeout=0.5)
     finally:
         if watchdog is not None:
             watchdog.stop()
@@ -242,6 +284,48 @@ def build_parser() -> argparse.ArgumentParser:
                    help="debug-bundle directory for the flight recorder "
                         "(span ring, /metrics snapshot, stack dump, "
                         "device memory)")
+    # Resilience layer (docs/architecture.md §Resilience).
+    p.add_argument("--executable_cache_dir", default=None,
+                   help="persistent executable cache directory: compiled "
+                        "bucket executables serialize here keyed by "
+                        "(config, shape, batch, tier, backend "
+                        "fingerprint), so a restarted server's prewarm "
+                        "is disk-bound instead of compile-bound; also "
+                        "enables jax's persistent compilation cache in "
+                        "the same directory")
+    p.add_argument("--max_dispatch_attempts", type=int, default=2,
+                   help="dispatch attempts per request before the typed "
+                        "RequestPoisoned failure (crashed dispatches "
+                        "requeue ahead of fresh work with exponential "
+                        "backoff); 1 disables retries")
+    p.add_argument("--retry_backoff_ms", type=float, default=20.0,
+                   help="base requeue backoff after a crashed dispatch "
+                        "(doubles per attempt)")
+    p.add_argument("--breaker_failures", type=int, default=3,
+                   help="consecutive dispatch failures that quarantine a "
+                        "device worker (circuit breaker opens; a "
+                        "half-open probe re-admits it after the "
+                        "cooldown)")
+    p.add_argument("--breaker_cooldown_s", type=float, default=1.0,
+                   help="circuit-breaker open -> half-open cooldown")
+    p.add_argument("--brownout", action="store_true",
+                   help="degrade before shedding: under sustained queue "
+                        "saturation / deadline misses, eligible requests "
+                        "run one tier-ladder rung cheaper (X-Degraded "
+                        "response header; X-No-Degrade opts a request "
+                        "out) and restore with hysteresis; needs >= 2 "
+                        "tiers")
+    p.add_argument("--brownout_exempt", default=None,
+                   help="comma list of tiers brownout must never "
+                        "degrade (e.g. 'quality' for contractual full-"
+                        "quality clients)")
+    p.add_argument("--chaos", default=None,
+                   help="FAULT INJECTION (testing only): comma key=value "
+                        "spec, e.g. 'crash=0.1,seed=7' for a 10%% "
+                        "injected worker-crash rate; keys crash/oom/"
+                        "compile/latency (rates), latency_ms, seed, "
+                        "max_faults, devices=0|1.  Off when unset — the "
+                        "dispatch path is bitwise-unchanged")
     common.add_arch_overrides(p)
     return p
 

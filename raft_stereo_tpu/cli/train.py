@@ -60,7 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--name", default="raft-stereo")
     p.add_argument("--restore_ckpt", default=None,
-                   help=".pth (warm start) or orbax dir (exact resume)")
+                   help=".pth (warm start), orbax dir (exact resume), or "
+                        "the literal 'latest' — exact resume from the "
+                        "newest VALID checkpoint under --checkpoint_dir "
+                        "for this --name (torn/partial checkpoints are "
+                        "skipped; the preemption-restart story)")
     p.add_argument("--warm_start", action="store_true",
                    help="load WEIGHTS ONLY from an orbax --restore_ckpt "
                         "(fresh optimizer/schedule — the fine-tune path)")

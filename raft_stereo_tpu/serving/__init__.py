@@ -1,18 +1,44 @@
 """Inference serving subsystem: the batch-N serving engine — bucketed
 batch executables, continuous batching, admission control + backpressure,
-waste-driven bucket selection, and a plain-text metrics endpoint.  See
-docs/architecture.md §Serving."""
+waste-driven bucket selection, supervised crash recovery (retries,
+per-device circuit breakers, brownout degradation, chaos testing, a
+persistent executable cache), and a plain-text metrics endpoint.  See
+docs/architecture.md §Serving and §Resilience."""
 
 from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
                                              Overloaded, Request,
+                                             RequestPoisoned,
                                              decompose_batch,
                                              pick_batch_size)
+from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
+                                           InjectedCompileFailure,
+                                           InjectedFault,
+                                           InjectedResourceExhausted,
+                                           InjectedWorkerCrash,
+                                           parse_chaos_spec)
 from raft_stereo_tpu.serving.engine import (BucketPolicy, ServeConfig,
                                             ServeResult, ServingEngine,
                                             StereoService)
 from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
+from raft_stereo_tpu.serving.persist import (ExecutableDiskCache,
+                                             enable_persistent_compilation_cache,
+                                             executable_cache_key)
+from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
+                                                CIRCUIT_HALF_OPEN,
+                                                CIRCUIT_OPEN,
+                                                BrownoutController,
+                                                CircuitBreaker,
+                                                circuit_state_name,
+                                                cost_ladder)
 
 __all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
-           "decompose_batch", "pick_batch_size", "BucketPolicy",
+           "RequestPoisoned", "decompose_batch", "pick_batch_size",
+           "ChaosConfig", "ChaosInjector", "InjectedCompileFailure",
+           "InjectedFault", "InjectedResourceExhausted",
+           "InjectedWorkerCrash", "parse_chaos_spec", "BucketPolicy",
            "MetricsRegistry", "ServingMetrics", "ServeConfig", "ServeResult",
-           "ServingEngine", "StereoService"]
+           "ServingEngine", "StereoService", "ExecutableDiskCache",
+           "enable_persistent_compilation_cache", "executable_cache_key",
+           "CIRCUIT_CLOSED", "CIRCUIT_HALF_OPEN", "CIRCUIT_OPEN",
+           "BrownoutController", "CircuitBreaker", "circuit_state_name",
+           "cost_ladder"]

@@ -29,6 +29,7 @@ scheduling policy in this file is testable in milliseconds.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import threading
 import time
@@ -52,6 +53,19 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before a device picked it up."""
 
 
+class RequestPoisoned(RuntimeError):
+    """Typed terminal failure of the supervised-recovery path: this
+    request's dispatch crashed on every one of its bounded attempts, so
+    it is failed individually instead of being retried forever or taking
+    the server down.  ``last_error`` is the final dispatch's exception."""
+
+    def __init__(self, message: str, attempts: int,
+                 last_error: Optional[BaseException] = None):
+        super().__init__(message)
+        self.attempts = attempts
+        self.last_error = last_error
+
+
 @dataclasses.dataclass(eq=False)   # identity equality: payloads hold arrays
 class Request:
     """One queued stereo pair.  ``payload`` is opaque to the queue (the
@@ -71,6 +85,13 @@ class Request:
     tier: Optional[str] = None
     trace: Optional[object] = None
     queue_span: Optional[object] = None
+    # Supervised-recovery bookkeeping (serving/engine.py): dispatch
+    # attempts so far (a crashed dispatch requeues the request until the
+    # engine's bound poisons it), and the tier the CLIENT asked for when
+    # brownout degradation reroutes ``tier`` down the ladder
+    # (``requested_tier is None`` means no degradation happened).
+    attempts: int = 0
+    requested_tier: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -179,6 +200,52 @@ class BucketQueue:
             self.metrics.queue_depth.set(self._depth)
             self._cond.notify()
 
+    def requeue(self, reqs: Sequence[Request]) -> int:
+        """Re-admit requests whose dispatch crashed (supervised recovery,
+        serving/engine.py).  Returns how many actually re-entered.
+
+        Differs from ``submit`` deliberately:
+
+        * **no admission bound** — these requests were already admitted
+          once; shedding them now would turn a transient device fault
+          into client-visible drops while fresh submits still succeed;
+        * **allowed while draining** — a drain must finish admitted work,
+          and that includes work bounced by a crash mid-drain (``close``
+          still fails them: the queue is gone);
+        * **ordered by admission time** — each request is inserted into
+          its bucket's FIFO by ``t_enqueue``, so a retried request rejoins
+          AHEAD of fresh requests that arrived after it (crashes must not
+          also cost queue position);
+        * **deduplicated** — a request already present in its bucket
+          (identity) or already resolved (its future is done: poisoned,
+          deadline-failed, or raced to completion) is skipped, so no
+          request can be dispatched twice.
+        """
+        requeued = 0
+        with self._cond:
+            if self._closed:
+                failed = [r for r in reqs if not r.future.done()]
+            else:
+                failed = []
+                for r in reqs:
+                    if r.future.done():
+                        continue
+                    fifo = self._buckets.setdefault(r.group_key, [])
+                    if any(q is r for q in fifo):
+                        continue
+                    keys = [q.t_enqueue for q in fifo]
+                    fifo.insert(bisect.bisect_right(keys, r.t_enqueue), r)
+                    self._depth += 1
+                    requeued += 1
+                self.metrics.queue_depth.set(self._depth)
+                if requeued:
+                    self._cond.notify_all()
+        for r in failed:
+            r.future.set_exception(
+                Overloaded("service shut down before this request could "
+                           "be retried", draining=True))
+        return requeued
+
     # ----------------------------------------------------------------- pop
     def _oldest_bucket(self) -> Optional[Tuple]:
         key, oldest = None, None
@@ -248,6 +315,17 @@ class BucketQueue:
             self._cond.notify_all()
 
     # ---------------------------------------------------------------- drain
+    def stop_admitting(self) -> None:
+        """Flip to draining WITHOUT waiting: fresh submits shed with the
+        typed draining ``Overloaded`` while queued work keeps flowing to
+        the workers (and crashed dispatches may still ``requeue``).
+        ``drain()`` is stop_admitting + wait-for-empty; the engine uses
+        this split so its drain can wait on queue depth, inflight count,
+        and pending retries as ONE combined condition."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admitting (submits raise ``Overloaded``) and wait until the
         workers have popped everything queued.  Returns False on timeout.

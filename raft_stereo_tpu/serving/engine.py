@@ -36,6 +36,32 @@ Shutdown mirrors the train loop's preemption story
 (training/train_loop.py): ``drain()`` refuses new work with the typed
 ``Overloaded``, lets the workers finish the queue, and only then stops
 them.
+
+Round 13 adds the failure story (docs/architecture.md §Resilience):
+
+* **Supervised recovery** — a crashed dispatch no longer silently fails
+  its whole batch: the requests requeue (ahead of fresh work, with
+  exponential backoff) for bounded retries, the worker thread is
+  restarted by the supervisor, and a request whose dispatch crashes
+  ``max_dispatch_attempts`` times fails individually with the typed
+  ``RequestPoisoned`` instead of retrying forever.  Every request
+  admitted terminates — success or typed error, never silence.
+* **Per-device circuit breakers** (serving/resilience.py) — K
+  consecutive failures quarantine a device (its worker stops popping);
+  after a cooldown one half-open probe batch decides whether it is back.
+* **Brownout degradation** — sustained queue-saturation /
+  deadline-miss pressure pushes eligible requests down the round-12
+  tier ladder (quality -> balanced -> interactive) instead of shedding;
+  hysteresis on restore.  Cheaper answers before no answers.
+* **Fault injection** (serving/chaos.py, ``ServeConfig.chaos``) —
+  deterministic seeded worker crashes / device OOM / latency / compile
+  failures prove all of the above in scripts/chaos_smoke.py; off by
+  default with the dispatch path bitwise-unchanged.
+* **Persistent executable cache** (serving/persist.py,
+  ``executable_cache_dir``) — compiled bucket executables serialize to
+  disk keyed by (config, shape, batch, tier, backend fingerprint), so a
+  restarted process prewarm is disk-bound, not compile-bound, and the
+  ``ready`` gate (/readyz) opens in seconds.
 """
 
 from __future__ import annotations
@@ -59,8 +85,15 @@ from raft_stereo_tpu.eval.runner import (early_exit_enabled,
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 from raft_stereo_tpu.ops.padding import InputPadder
 from raft_stereo_tpu.serving.batcher import (BucketQueue, Overloaded,
-                                             Request, decompose_batch)
+                                             Request, RequestPoisoned,
+                                             decompose_batch)
+from raft_stereo_tpu.serving.chaos import ChaosConfig, ChaosInjector
 from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
+from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
+                                                BrownoutController,
+                                                CircuitBreaker,
+                                                circuit_state_name,
+                                                cost_ladder)
 
 log = logging.getLogger(__name__)
 
@@ -109,7 +142,15 @@ class ServeConfig:
     max_padding_waste: float = 0.10
     # Raw (H, W) shapes whose bucket ladder (all batch sizes) is compiled
     # at boot — cold-start work moved out of the first requests' path.
+    # Also the READINESS target: /readyz reports ready only once every
+    # (worker, bucket, batch, tier-family) entry of this surface has
+    # dispatched once.
     warmup_shapes: Tuple[Tuple[int, int], ...] = ()
+    # False: declare the warm surface (readiness gates on it) but let the
+    # caller drive ``prewarm`` itself — the CLI does this so the HTTP
+    # server answers /readyz "warming" DURING the warm-up and so compile
+    # events land in the run-event log wired after construction.
+    prewarm_on_init: bool = True
     max_cached_shapes: int = 16  # per-worker (bucket, batch) executables
     fetch_dtype: Optional[str] = None    # "fp16" | "bf16" half fetch
     default_deadline_ms: Optional[float] = None  # per-request override wins
@@ -130,6 +171,43 @@ class ServeConfig:
     # MFU denominator override (TFLOP/s); None = the auto table keyed by
     # the local device kind (costs.DEVICE_PEAK_TFLOPS).
     device_peak_tflops: Optional[float] = None
+    # ---- Resilience (round 13; docs/architecture.md §Resilience) -------
+    # Deterministic fault injection (serving/chaos.py).  None (default):
+    # chaos off, the dispatch path is a single attribute check away from
+    # the round-12 program — bitwise-unchanged, tested.
+    chaos: Optional[ChaosConfig] = None
+    # Supervised recovery: a request whose dispatch crashes requeues
+    # (ahead of fresh work) until it has been attempted this many times,
+    # then fails with the typed RequestPoisoned.  1 = no retries.
+    max_dispatch_attempts: int = 2
+    # Backoff before a crashed batch's requests re-enter the queue:
+    # retry_backoff_ms * 2^(attempt-1), so a flapping device is not
+    # hammered by its own bounce-backs.
+    retry_backoff_ms: float = 20.0
+    # Per-device circuit breaker: this many CONSECUTIVE dispatch failures
+    # quarantine the device; after breaker_cooldown_s one half-open probe
+    # batch decides recovery (serving/resilience.py).
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 1.0
+    # Brownout degradation: under sustained queue-saturation or
+    # deadline-miss pressure, push eligible requests down the tier
+    # ladder (cheapest tier = highest early-exit threshold) instead of
+    # shedding; restore with hysteresis.  Requires tiers.
+    brownout: bool = False
+    brownout_engage_fraction: float = 0.75
+    brownout_engage_s: float = 0.5
+    brownout_restore_fraction: float = 0.25
+    brownout_restore_s: float = 2.0
+    brownout_poll_s: float = 0.1
+    # Tiers that must NEVER be degraded (the per-tier opt-out; clients
+    # additionally opt out per request via submit(degradable=False) /
+    # the X-No-Degrade header).
+    brownout_exempt_tiers: Tuple[str, ...] = ()
+    # Persistent AOT executable cache directory (serving/persist.py):
+    # compiled bucket executables serialize here keyed by (config, shape,
+    # batch, tier, backend fingerprint) so a restarted process prewarm
+    # loads from disk instead of recompiling.  None (default) = off.
+    executable_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -169,6 +247,35 @@ class ServeConfig:
             raise ValueError(
                 f"default_tier={self.default_tier!r} is not one of the "
                 f"configured tiers {names}")
+        if self.max_dispatch_attempts < 1:
+            raise ValueError(f"max_dispatch_attempts="
+                             f"{self.max_dispatch_attempts} must be >= 1")
+        if self.retry_backoff_ms < 0:
+            raise ValueError(f"retry_backoff_ms={self.retry_backoff_ms} "
+                             f"must be >= 0")
+        if self.breaker_failures < 1:
+            raise ValueError(f"breaker_failures={self.breaker_failures} "
+                             f"must be >= 1")
+        if self.breaker_cooldown_s <= 0:
+            raise ValueError(f"breaker_cooldown_s="
+                             f"{self.breaker_cooldown_s} must be > 0")
+        if self.brownout:
+            if len(names) < 2:
+                raise ValueError(
+                    "brownout=True needs at least two configured tiers — "
+                    "the degradation ladder IS the tier ladder")
+            if not (0 < self.brownout_restore_fraction
+                    <= self.brownout_engage_fraction <= 1):
+                raise ValueError(
+                    f"need 0 < brownout_restore_fraction "
+                    f"({self.brownout_restore_fraction}) <= "
+                    f"brownout_engage_fraction "
+                    f"({self.brownout_engage_fraction}) <= 1")
+        for t in self.brownout_exempt_tiers:
+            if t not in names:
+                raise ValueError(
+                    f"brownout_exempt_tiers={self.brownout_exempt_tiers}: "
+                    f"{t!r} is not one of the configured tiers {names}")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -188,7 +295,17 @@ class ServeResult:
     iters_used: Optional[int] = None  # GRU trip count of the dispatch
     #                              (the worst batch member's depth; the
     #                              configured depth on fixed-iters paths)
-    tier: Optional[str] = None   # latency tier the request ran at
+    tier: Optional[str] = None   # latency tier the request RAN at
+    # Brownout provenance: the tier the client asked for when it differs
+    # from ``tier`` (None = served as requested).  The HTTP layer renders
+    # this as the X-Degraded header.
+    requested_tier: Optional[str] = None
+    attempts: int = 1            # dispatch attempts including the one
+    #                              that succeeded (> 1 = recovered crash)
+
+    @property
+    def degraded(self) -> bool:
+        return self.requested_tier is not None
 
     @property
     def disparity(self) -> np.ndarray:
@@ -288,6 +405,21 @@ class BucketPolicy:
     def refined_buckets(self) -> Tuple[Tuple[int, int], ...]:
         with self._lock:
             return tuple(sorted(self._refined))
+
+
+class _SinkRef:
+    """Late-bound anomaly-sink handle: the brownout controller (and any
+    other long-lived component) holds this instead of the sink itself,
+    because the CLI attaches the sink after the engine is constructed."""
+
+    def __init__(self, engine: "ServingEngine"):
+        self._engine = engine
+
+    def fire(self, kind: str, **detail):
+        sink = self._engine.sink
+        if sink is not None:
+            return sink.fire(kind, **detail)
+        return None
 
 
 class ServingEngine:
@@ -392,15 +524,105 @@ class ServingEngine:
             max_batch=serve_cfg.max_batch,
             batch_sizes=serve_cfg.batch_sizes,
             max_queue=serve_cfg.max_queue, metrics=self.metrics)
+        # ---- Resilience layer (round 13) -------------------------------
+        # Anomaly sink (telemetry/watchdog.AnomalySink | None): fires
+        # worker_crash / circuit / brownout / poisoned events into the
+        # run-event log + flight recorder.  The CLI attaches it after
+        # construction (attach_anomaly_sink) because the event log is
+        # wired after the engine exists; every fire site reads the
+        # attribute at fire time.
+        self.sink = None
+        # Chaos injector: None unless configured AND enabled — the
+        # dispatch path then carries exactly one attribute check.
+        self.chaos: Optional[ChaosInjector] = None
+        if serve_cfg.chaos is not None and serve_cfg.chaos.enabled:
+            self.chaos = ChaosInjector(
+                serve_cfg.chaos,
+                observe=self.metrics.observe_injected_fault)
+            log.warning("CHAOS ENABLED: %s — injected faults are ON for "
+                        "this engine", serve_cfg.chaos)
+        # Per-device circuit breakers; gauges start in the closed state
+        # so /metrics shows every device's circuit from boot.
+        self.breakers = [
+            CircuitBreaker(
+                failure_threshold=serve_cfg.breaker_failures,
+                cooldown_s=serve_cfg.breaker_cooldown_s,
+                on_state=self._make_circuit_callback(i))
+            for i in range(len(self.devices))]
+        for i in range(len(self.devices)):
+            self.metrics.circuit_gauge(i).set(CIRCUIT_CLOSED)
+        # Brownout controller over the tier cost ladder (cheapest-first).
+        self.brownout: Optional[BrownoutController] = None
+        if serve_cfg.brownout:
+            self.brownout = BrownoutController(
+                self.metrics, serve_cfg.max_queue,
+                ladder=cost_ladder(serve_cfg.parsed_tiers()),
+                engage_fraction=serve_cfg.brownout_engage_fraction,
+                engage_s=serve_cfg.brownout_engage_s,
+                restore_fraction=serve_cfg.brownout_restore_fraction,
+                restore_s=serve_cfg.brownout_restore_s,
+                poll_s=serve_cfg.brownout_poll_s,
+                gauge=self.metrics.brownout_level,
+                sink=_SinkRef(self)).start()
+        # Persistent executable cache (serving/persist.py).
+        self.disk_cache = None
+        if serve_cfg.executable_cache_dir:
+            from raft_stereo_tpu.serving.persist import ExecutableDiskCache
+            self.disk_cache = ExecutableDiskCache(
+                serve_cfg.executable_cache_dir)
+        # Retry bookkeeping: requests bounced by a crashed dispatch sit in
+        # backoff timers between dequeue and requeue — drain() must wait
+        # for them and close() must fail them, so they are accounted here.
+        self._retry_lock = threading.Lock()
+        self._pending_retries = 0
+        self._retry_timers: set = set()   # (Timer, reqs) pairs
+        # Readiness (the /readyz gate): the configured warm surface is
+        # warmup_shapes x distinct executable families x batch sizes x
+        # workers; ready once every entry has dispatched once (prewarm or
+        # traffic).  No configured warmup -> ready at boot.
+        self._warm_lock = threading.Lock()
+        self._warmed: set = set()
+        self._warm_target: set = set()
+        for hw in serve_cfg.warmup_shapes:
+            hp, wp, _ = self.policy.bucket_for(int(hw[0]), int(hw[1]))
+            for widx in range(len(self.devices)):
+                for tier in self._distinct_cache_tiers():
+                    for n in self.queue.sizes:
+                        self._warm_target.add((widx, (hp, wp), n, tier))
         self._closed = False
+        self._workers_lock = threading.Lock()
         self._workers = [
             threading.Thread(target=self._worker_loop, args=(i,),
                              daemon=True, name=f"stereo-worker-{i}")
             for i in range(len(self.devices))]
         for t in self._workers:
             t.start()
-        for hw in serve_cfg.warmup_shapes:
-            self.prewarm(hw)
+        if serve_cfg.prewarm_on_init:
+            for hw in serve_cfg.warmup_shapes:
+                self.prewarm(hw)
+
+    def _make_circuit_callback(self, widx: int):
+        """Breaker transition hook for one device: gauge + anomaly event.
+        Opening the circuit is the page-worthy event (a device is
+        quarantined); closing is the all-clear."""
+        def on_state(old: int, new: int, failures: int) -> None:
+            self.metrics.circuit_gauge(widx).set(new)
+            log.warning("device %d circuit %s -> %s (%d consecutive "
+                        "failures)", widx, circuit_state_name(old),
+                        circuit_state_name(new), failures)
+            sink = self.sink
+            if sink is not None:
+                sink.fire(f"circuit_{circuit_state_name(new)}",
+                          device=widx,
+                          previous=circuit_state_name(old),
+                          consecutive_failures=failures)
+        return on_state
+
+    def attach_anomaly_sink(self, sink) -> None:
+        """Wire an AnomalySink (telemetry/watchdog.py): resilience
+        transitions emit anomaly run events + flight-recorder bundles
+        through the same path the watchdogs use."""
+        self.sink = sink
 
     # ----------------------------------------------------------- back-compat
     @property
@@ -428,7 +650,8 @@ class ServingEngine:
 
     def submit(self, left: np.ndarray, right: np.ndarray,
                deadline_ms: Optional[float] = None,
-               tier: Optional[str] = None) -> Future:
+               tier: Optional[str] = None,
+               degradable: bool = True) -> Future:
         """Admit one stereo pair; returns a Future of ``ServeResult``.
 
         ``tier`` selects a configured latency tier (``ServeConfig.tiers``)
@@ -437,10 +660,23 @@ class ServingEngine:
         fixed-depth path when no tiers are configured).  Raises
         ``Overloaded`` at the door when the queue is full or the engine is
         draining; the Future fails with ``DeadlineExceeded`` if the
-        request's deadline passes before a device picks it up.
+        request's deadline passes before a device picks it up, or with
+        ``RequestPoisoned`` if its dispatch crashes on every bounded
+        retry.  Under active brownout (``ServeConfig.brownout``) an
+        eligible request is rerouted down the tier ladder —
+        ``degradable=False`` opts this request out (the HTTP layer maps
+        the X-No-Degrade header here), and ``brownout_exempt_tiers``
+        opts a whole tier out; a degraded result carries
+        ``requested_tier`` / ``degraded``.
         """
         t_admit = time.perf_counter()
         tier = self.resolve_tier(tier)
+        requested_tier = None
+        if (self.brownout is not None and degradable
+                and tier not in self.serve_cfg.brownout_exempt_tiers):
+            effective = self.brownout.degrade(tier)
+            if effective != tier:
+                requested_tier, tier = tier, effective
         left, right = np.asarray(left), np.asarray(right)
         if left.ndim != 3 or left.shape != right.shape:
             raise ValueError(
@@ -458,6 +694,7 @@ class ServingEngine:
                        else self.serve_cfg.default_deadline_ms)
         req = Request(bucket=(hp, wp), payload=payload,
                       future=Future(), t_enqueue=now, tier=tier,
+                      requested_tier=requested_tier,
                       deadline=(None if deadline_ms is None
                                 else now + deadline_ms / 1e3))
         # Sampled request: root span + admission (validate/pad) span; the
@@ -482,6 +719,10 @@ class ServingEngine:
                 trace.root.set_attr("status", "overloaded")
                 self._finish_request_trace(req, None)
             raise
+        if requested_tier is not None:
+            self.metrics.degraded.inc()
+            if trace is not None and trace.root is not None:
+                trace.root.set_attr("degraded_from", requested_tier)
         return req.future
 
     def _finish_request_trace(self, req: Request, future) -> None:
@@ -502,10 +743,43 @@ class ServingEngine:
     def infer(self, left: np.ndarray, right: np.ndarray,
               deadline_ms: Optional[float] = None,
               timeout: Optional[float] = None,
-              tier: Optional[str] = None) -> ServeResult:
+              tier: Optional[str] = None,
+              degradable: bool = True) -> ServeResult:
         """Blocking convenience: submit + wait (the in-process client)."""
-        return self.submit(left, right, deadline_ms,
-                           tier=tier).result(timeout=timeout)
+        return self.submit(left, right, deadline_ms, tier=tier,
+                           degradable=degradable).result(timeout=timeout)
+
+    # ------------------------------------------------------------ readiness
+    @property
+    def ready(self) -> bool:
+        """The /readyz gate: every configured (worker, bucket, batch,
+        tier-family) warm entry has dispatched at least once.  True at
+        boot when no ``warmup_shapes`` are configured — an engine with no
+        declared warm surface is ready by definition (it just pays
+        first-request compiles, as before)."""
+        with self._warm_lock:
+            return self._warm_target <= self._warmed
+
+    def warm_status(self) -> Dict[str, object]:
+        """Readiness detail for /readyz: progress through the configured
+        bucket x batch x tier ladder, plus the disk-cache counters that
+        say whether warmness came from disk or from XLA."""
+        with self._warm_lock:
+            done = len(self._warm_target & self._warmed)
+            total = len(self._warm_target)
+            ready = self._warm_target <= self._warmed
+        out: Dict[str, object] = {"ready": ready, "warm_done": done,
+                                  "warm_target": total}
+        out["compiles_cold"] = self.metrics.compiles_cold.value
+        out["compiles_warm"] = self.metrics.compiles_warm.value
+        if self.disk_cache is not None:
+            out["executable_cache"] = self.disk_cache.stats()
+        return out
+
+    def _note_warm(self, widx: int, bucket: Tuple[int, int], batch: int,
+                   cache_tier: Optional[str]) -> None:
+        with self._warm_lock:
+            self._warmed.add((widx, tuple(bucket), batch, cache_tier))
 
     # --------------------------------------------------------- compile cache
     def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
@@ -515,6 +789,14 @@ class ServingEngine:
         if tier is None or self._tier_models.get(tier) is self.model:
             return None
         return tier
+
+    def _distinct_cache_tiers(self) -> List[Optional[str]]:
+        """The DISTINCT executable families the configured tiers compile
+        to ("quality" and the base path normalize to one cache key) —
+        what prewarm and the readiness target iterate."""
+        tiers = tuple(self.tiers) if self.tiers else (None,)
+        return sorted({self._cache_tier(t) for t in tiers},
+                      key=lambda t: (t is not None, t or ""))
 
     def _cost_key(self, bucket: Tuple[int, int], batch: int,
                   tier: Optional[str] = None) -> str:
@@ -549,10 +831,17 @@ class ServingEngine:
         fwd = make_forward(self._tier_models[tier], self.serve_cfg.iters,
                            self._fetch_jax_dtype(),
                            donate_images=self.serve_cfg.donate_buffers)
-        if self.costs is not None:
-            fwd = self.costs.instrument(
-                fwd, key=self._cost_key(bucket, batch, tier),
-                site="serving")
+        if self.disk_cache is not None:
+            fwd = self._load_or_compile(fwd, bucket, batch, worker, tier)
+        else:
+            # No persistent cache: the executable is built by XLA (at
+            # first dispatch on the plain-jit path, inside instrument on
+            # the cost path) — a cold compile either way.
+            self.metrics.compiles_cold.inc()
+            if self.costs is not None:
+                fwd = self.costs.instrument(
+                    fwd, key=self._cost_key(bucket, batch, tier),
+                    site="serving")
         with self._cache_lock:
             mine = [k for k in self._compiled if k[0] == worker]
             while len(mine) >= self.serve_cfg.max_cached_shapes:
@@ -573,6 +862,69 @@ class ServingEngine:
             if self.costs is not None:
                 self.costs.note_runner_cache_size(len(self._compiled))
         return fwd
+
+    def _disk_key(self, bucket: Tuple[int, int], batch: int,
+                  worker: int, cache_tier: Optional[str]) -> str:
+        """The persistent-cache content key of one compile point: every
+        coordinate that selects a distinct program, plus the device the
+        serialized executable is bound to (persist.py mixes in the
+        jax/backend fingerprint)."""
+        from raft_stereo_tpu.serving.persist import executable_cache_key
+
+        return executable_cache_key(
+            config=self._tier_models[cache_tier].config.to_json(),
+            bucket=tuple(bucket), batch=int(batch),
+            tier=cache_tier, iters=self.serve_cfg.iters,
+            fetch_dtype=self.serve_cfg.fetch_dtype,
+            donate=self.serve_cfg.donate_buffers,
+            device=str(getattr(self.devices[worker], "id", worker)))
+
+    def _load_or_compile(self, fwd, bucket: Tuple[int, int], batch: int,
+                         worker: int, cache_tier: Optional[str]):
+        """The persistent-cache build path: deserialize the executable
+        from disk (warm — no XLA compile paid) or AOT-compile it now and
+        store it for the next boot (cold).  Either way the cost registry
+        (when attached) gets its record, so /debug/compiles stays the
+        complete executable inventory.  Falls back to the plain callable
+        when the AOT machinery is unavailable — the cache can never take
+        the dispatch path down."""
+        import jax
+
+        disk_key = self._disk_key(bucket, batch, worker, cache_tier)
+        t0 = time.perf_counter()
+        exe = self.disk_cache.load(disk_key)
+        if exe is not None:
+            self.metrics.compiles_warm.inc()
+            log.info("bucket %s batch %d tier %s worker %d: executable "
+                     "restored from persistent cache in %.3fs", bucket,
+                     batch, cache_tier, worker, time.perf_counter() - t0)
+            if self.costs is not None:
+                self.costs.record(
+                    self._cost_key(bucket, batch, cache_tier), "serving",
+                    time.perf_counter() - t0, compiled=exe)
+            return exe
+        aval = jax.ShapeDtypeStruct((batch, bucket[0], bucket[1], 3),
+                                    np.uint8)
+        try:
+            compiled = fwd.lower(self._worker_vars[worker], aval,
+                                 aval).compile()
+        except Exception:
+            log.warning("AOT compile for the persistent cache failed; "
+                        "falling back to plain jit dispatch (this "
+                        "executable will not be cached)", exc_info=True)
+            self.metrics.compiles_cold.inc()
+            if self.costs is not None:
+                return self.costs.instrument(
+                    fwd, key=self._cost_key(bucket, batch, cache_tier),
+                    site="serving")
+            return fwd
+        compile_s = time.perf_counter() - t0
+        self.metrics.compiles_cold.inc()
+        if self.costs is not None:
+            self.costs.record(self._cost_key(bucket, batch, cache_tier),
+                              "serving", compile_s, compiled=compiled)
+        self.disk_cache.store(disk_key, compiled)
+        return compiled
 
     def _fetch_jax_dtype(self):
         import jax.numpy as jnp
@@ -601,11 +953,12 @@ class ServingEngine:
         hp, wp, _ = self.policy.bucket_for(h, w)
         sizes = tuple(batch_sizes) if batch_sizes else self.queue.sizes
         if tiers is None:
-            tiers = tuple(self.tiers) if self.tiers else (None,)
-        # Distinct executable families only: "quality" and the base path
-        # normalize to the same cache key.
-        cache_tiers = sorted({self._cache_tier(t) for t in tiers},
-                             key=lambda t: (t is not None, t or ""))
+            cache_tiers = self._distinct_cache_tiers()
+        else:
+            # Distinct executable families only: "quality" and the base
+            # path normalize to the same cache key.
+            cache_tiers = sorted({self._cache_tier(t) for t in tiers},
+                                 key=lambda t: (t is not None, t or ""))
         for widx, dev in enumerate(self.devices):
             for tier in cache_tiers:
                 for n in sizes:
@@ -616,6 +969,7 @@ class ServingEngine:
                               jax.device_put(zeros, dev),
                               jax.device_put(zeros.copy(), dev))
                     jax.block_until_ready(out)
+                    self._note_warm(widx, (hp, wp), n, tier)
         log.info("prewarmed bucket %dx%d batch sizes %s (%d executable "
                  "famil%s) on %d worker(s)", hp, wp, sizes,
                  len(cache_tiers), "y" if len(cache_tiers) == 1 else "ies",
@@ -623,20 +977,125 @@ class ServingEngine:
 
     # --------------------------------------------------------------- workers
     def _worker_loop(self, widx: int) -> None:
+        """One device worker under supervision.  The circuit breaker
+        gates the pop (an open circuit = this device takes no work); a
+        dispatch crash hands the batch to the recovery path and then
+        RESTARTS the worker thread — a crashed dispatch must never kill
+        the server, and a fresh thread is the cheapest guarantee that no
+        corrupted per-thread state survives the crash."""
+        breaker = self.breakers[widx]
         while True:
+            delay = breaker.until_allowed()
+            if delay > 0:
+                if self._closed:
+                    return
+                time.sleep(min(delay, 0.05))
+                continue
             batch = self.queue.pop()
             if batch is None:       # queue closed: worker shutdown
                 return
             try:
                 self._run_batch(widx, batch)
-            except BaseException as e:  # noqa: BLE001 — fail the batch, not
-                self.metrics.failed.inc(len(batch))       # the worker thread
-                log.exception("batch of %d failed", len(batch))
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-            finally:
+                breaker.record_success()
+            except BaseException as e:  # noqa: BLE001 — recover, restart
+                self._on_dispatch_failure(widx, batch, e)
                 self.metrics.inflight.dec(len(batch))
+                self._restart_worker(widx)
+                return              # this thread exits; successor took over
+            self.metrics.inflight.dec(len(batch))
+
+    # ---------------------------------------------------- supervised recovery
+    def _on_dispatch_failure(self, widx: int, batch: List[Request],
+                             exc: BaseException) -> None:
+        """The recovery path for one crashed dispatch: record the breaker
+        failure, requeue the batch's unresolved requests with backoff, and
+        poison the ones that exhausted their attempts.  Chunks of the
+        batch that already completed (futures done) are untouched."""
+        pending = [r for r in batch if not r.future.done()]
+        log.exception("dispatch of %d request(s) crashed on worker %d "
+                      "(%d unresolved)", len(batch), widx, len(pending))
+        self.breakers[widx].record_failure()
+        sink = self.sink
+        if sink is not None:
+            sink.fire("worker_crash", device=widx, batch_size=len(batch),
+                      unresolved=len(pending),
+                      error=f"{type(exc).__name__}: {exc}")
+        retry: List[Request] = []
+        now_pc = time.perf_counter()
+        for r in pending:
+            r.attempts += 1
+            if r.attempts >= self.serve_cfg.max_dispatch_attempts:
+                self.metrics.poisoned.inc()
+                self.metrics.failed.inc()
+                if r.trace is not None and r.trace.root is not None:
+                    r.trace.root.set_attr("attempts", r.attempts)
+                r.future.set_exception(RequestPoisoned(
+                    f"dispatch crashed on all {r.attempts} attempts "
+                    f"(last: {type(exc).__name__}: {exc})",
+                    attempts=r.attempts, last_error=exc))
+            else:
+                retry.append(r)
+        if not retry:
+            return
+        self.metrics.retries.inc(len(retry))
+        attempt = max(r.attempts for r in retry)
+        backoff_s = (self.serve_cfg.retry_backoff_ms / 1e3
+                     * 2 ** (attempt - 1))
+        for r in retry:
+            if r.trace is not None:
+                self.tracer.add_span(
+                    "serve.retry", r.trace, now_pc, time.perf_counter(),
+                    attempt=r.attempts, device=widx,
+                    backoff_ms=round(backoff_s * 1e3, 3),
+                    error=type(exc).__name__)
+        self._schedule_requeue(retry, backoff_s)
+
+    def _schedule_requeue(self, reqs: List[Request],
+                          delay_s: float) -> None:
+        """Requeue ``reqs`` after ``delay_s`` on a backoff timer.  The
+        pending-retry count keeps ``drain`` honest (requests in backoff
+        are neither queued nor inflight) and ``close`` fails the timers'
+        requests instead of stranding them."""
+        with self._retry_lock:
+            self._pending_retries += len(reqs)
+
+        entry = None
+
+        def _requeue():
+            try:
+                self.queue.requeue(reqs)   # closed queue -> typed failure
+            finally:
+                with self._retry_lock:
+                    self._pending_retries -= len(reqs)
+                    self._retry_timers.discard(entry)
+
+        timer = threading.Timer(max(0.0, delay_s), _requeue)
+        timer.daemon = True
+        entry = (timer, tuple(reqs))
+        with self._retry_lock:
+            self._retry_timers.add(entry)
+        timer.start()
+
+    def _pending_retry_count(self) -> int:
+        with self._retry_lock:
+            return self._pending_retries
+
+    def _restart_worker(self, widx: int) -> None:
+        """Supervisor: replace a crashed worker thread with a fresh one
+        on the same device (unless the engine is closing)."""
+        with self._workers_lock:
+            if self._closed:
+                return
+            t = threading.Thread(target=self._worker_loop, args=(widx,),
+                                 daemon=True, name=f"stereo-worker-{widx}")
+            # Start inside the lock so close() can never snapshot (and
+            # try to join) a thread that was not started yet.
+            self._workers[widx] = t
+            t.start()
+        self.metrics.worker_restarts.inc()
+        log.warning("worker %d restarted after dispatch crash "
+                    "(restart #%d)", widx,
+                    self.metrics.worker_restarts.value)
 
     def _run_batch(self, widx: int, batch: List[Request]) -> None:
         """One popped batch.  The scheduler pops exact bucket sizes, but
@@ -667,6 +1126,15 @@ class ServingEngine:
             if r.queue_span is not None and r.queue_span.t_end is None:
                 r.queue_span.set_attr("batch_size", n)
                 self.tracer.finish(r.queue_span)
+
+        # Fault injection (serving/chaos.py): one attribute check when
+        # chaos is off — the no-chaos dispatch path is the round-12
+        # program, bitwise-unchanged (tests/test_resilience.py).  The
+        # injected exceptions propagate into the worker loop's recovery
+        # path exactly like organic faults.
+        if self.chaos is not None:
+            self.chaos.on_compile(widx)
+            self.chaos.on_dispatch(widx)
 
         with profiling.annotate("serve.device"):
             # ONE batch-n dispatch through the (bucket, n) executable.
@@ -702,7 +1170,7 @@ class ServingEngine:
             self.tracer.add_span(
                 "serve.dispatch", r.trace, p_pickup, p_ready,
                 bucket=str(bucket), batch_size=n, device=str(device),
-                iters_used=iters_used,
+                iters_used=iters_used, attempt=r.attempts + 1,
                 **({"tier": tier} if tier is not None else {}))
             self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
                                  batch_size=n)
@@ -742,6 +1210,7 @@ class ServingEngine:
                 self.metrics.dispatched_flops.inc(rec.flops)
                 self._mfu.note(rec.flops)
         self.metrics.note_batch_done()
+        self._note_warm(widx, bucket, n, self._cache_tier(tier))
         for r, fp, wait in zip(batch, flows_padded, waits):
             exemplar = r.trace.trace_id if r.trace is not None else None
             p_respond = time.perf_counter() if exemplar is not None else 0.0
@@ -755,7 +1224,8 @@ class ServingEngine:
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
-                batch_size=n, iters_used=iters_used, tier=tier))
+                batch_size=n, iters_used=iters_used, tier=tier,
+                requested_tier=r.requested_tier, attempts=r.attempts + 1))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())
@@ -763,15 +1233,16 @@ class ServingEngine:
     # -------------------------------------------------------------- shutdown
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful SIGTERM story: refuse new work (``Overloaded``), let
-        the workers finish the queue and in-flight batches, stop them.
-        Returns False if ``timeout`` elapsed first (workers are still
-        stopped; any stranded requests fail rather than hang)."""
-        t0 = time.monotonic()
-        ok = self.queue.drain(timeout=timeout)
-        remaining = (None if timeout is None
-                     else max(0.0, timeout - (time.monotonic() - t0)))
-        deadline = None if remaining is None else time.monotonic() + remaining
-        while self.metrics.inflight.value > 0:
+        the workers finish the queue, in-flight batches, AND any crashed
+        requests sitting in retry backoff, then stop them.  Returns False
+        if ``timeout`` elapsed first (workers are still stopped; any
+        stranded requests fail rather than hang)."""
+        self.queue.stop_admitting()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        ok = True
+        while (self.queue.depth > 0 or self.metrics.inflight.value > 0
+               or self._pending_retry_count() > 0):
             if deadline is not None and time.monotonic() > deadline:
                 ok = False
                 break
@@ -781,13 +1252,27 @@ class ServingEngine:
 
     def close(self) -> None:
         """Hard stop: closes the queue (queued requests fail with
-        ``Overloaded``; blocked worker pops return None) and joins the
-        worker threads.  ``drain`` first for the graceful version."""
+        ``Overloaded``; blocked worker pops return None), cancels retry
+        backoff timers (their requests fail the same typed way instead of
+        hanging), stops the brownout controller, and joins the worker
+        threads.  ``drain`` first for the graceful version."""
         if self._closed:
             return
         self._closed = True
+        if self.brownout is not None:
+            self.brownout.stop()
         self.queue.close()
-        for t in self._workers:
+        # Retry timers: cancel, then run each timer's requeue through the
+        # now-closed queue so its requests get the typed shutdown failure
+        # (requeue dedups, so racing an already-fired timer is safe).
+        with self._retry_lock:
+            entries = list(self._retry_timers)
+        for timer, reqs in entries:
+            timer.cancel()
+            self.queue.requeue(list(reqs))
+        with self._workers_lock:
+            workers = list(self._workers)
+        for t in workers:
             t.join(timeout=5.0)
 
     def __enter__(self):

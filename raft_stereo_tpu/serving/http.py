@@ -18,12 +18,26 @@ Endpoints:
     - ``png``: 16-bit PNG, disparity*256 (the KITTI on-disk convention —
       data/frame_utils.write_disp_kitti reads it back losslessly to
       1/256 px).
-  Errors map to transport codes: 429 (queue full, with ``Retry-After``),
-  503 (draining), 504 (deadline passed in queue), 400 (malformed input).
+  Errors map to transport codes with TYPED JSON bodies so clients can
+  machine-react: 429 (queue full) and 503 (draining) both carry
+  ``{"error": "overloaded", "retry_after_s": N}`` plus the matching
+  ``Retry-After`` header (back off instead of hammering); 504 (deadline
+  passed in queue); 500 with ``{"error": "request_poisoned",
+  "attempts": N}`` when a request's dispatch crashed on every bounded
+  retry (serving/engine.py supervised recovery); 400 (malformed input).
+  Under brownout degradation a response served at a cheaper tier than
+  requested carries ``X-Degraded: <requested>-><served>``; the
+  ``X-No-Degrade`` request header opts one request out.
 * ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
-* ``GET /healthz`` — one JSON line: status, queue depth, inflight count,
-  last-batch age, device count (the load balancer's liveness probe AND a
-  human's first diagnostic stop).
+* ``GET /healthz`` — LIVENESS: one JSON line (status, queue depth,
+  inflight count, last-batch age, device count, readiness) answered
+  whenever the process and its queue exist.  A restart-looping load
+  balancer should probe this.
+* ``GET /readyz`` — READINESS: 200 only once the configured
+  bucket x tier x batch warm ladder has fully compiled (or restored
+  from the persistent executable cache); 503 with warm progress before
+  that.  Pointing traffic here keeps cold pods out of rotation while
+  they prewarm (docs/architecture.md §Resilience).
 * ``POST /debug/trace`` — bounded on-demand profiler window on the live
   serving process (telemetry/trace.py); optional JSON body
   ``{"duration_ms": N}``; replies with the trace directory, 409 while a
@@ -53,7 +67,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from raft_stereo_tpu.serving.batcher import DeadlineExceeded, Overloaded
+from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
+                                             RequestPoisoned)
 from raft_stereo_tpu.serving.service import StereoService
 from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
 from raft_stereo_tpu.telemetry.http import (handle_debug_get,
@@ -134,15 +149,27 @@ def make_handler(service: StereoService,
                 self._reply(200, service.metrics.render_text().encode(),
                             "text/plain; version=0.0.4")
             elif path == "/healthz":
+                # Liveness: answers as long as the process is up; the
+                # readiness decision lives on /readyz (split so a warm
+                # restart is not health-flapped out of existence while
+                # it prewarms).
                 self._reply_json(200, {
                     "status": ("draining" if service.queue.draining
                                else "ok"),
+                    "ready": service.ready,
                     "queue_depth": service.queue.depth,
                     "inflight": service.metrics.inflight.value,
                     "last_batch_age_s":
                         service.metrics.last_batch_age_s(),
                     "anomalies": service.metrics.anomalies.value,
+                    "brownout_level":
+                        service.metrics.brownout_level.value,
                     "devices": len(service.devices)})
+            elif path == "/readyz":
+                status = service.warm_status()
+                status["status"] = ("ready" if status["ready"]
+                                    else "warming")
+                self._reply_json(200 if status["ready"] else 503, status)
             elif handle_debug_get(path, url.query, service.tracer, recorder,
                                   service.metrics.registry,
                                   self._reply, self._reply_json,
@@ -180,22 +207,35 @@ def make_handler(service: StereoService,
                     self.headers.get("X-Tier")
                 if tier is not None:
                     service.resolve_tier(tier)  # 400 on unknown tiers
+                degradable = self.headers.get("X-No-Degrade") is None
             except (ValueError, KeyError, OSError) as e:
                 self._reply_json(400, {"error": str(e)})
                 return
             try:
                 result = service.infer(left, right, deadline_ms=deadline_ms,
-                                       tier=tier)
+                                       tier=tier, degradable=degradable)
             except Overloaded as e:
-                if e.draining:
-                    self._reply_json(503, {"error": str(e)},
-                                     extra_headers=[("Retry-After", "5")])
-                else:
-                    self._reply_json(429, {"error": str(e)},
-                                     extra_headers=[("Retry-After", "1")])
+                # Typed overload contract: machine-readable body + the
+                # matching Retry-After, so clients back off instead of
+                # hammering a saturated (or draining) server.
+                retry_after_s = 5.0 if e.draining else 1.0
+                body = {"error": "overloaded",
+                        "retry_after_s": retry_after_s,
+                        "draining": e.draining,
+                        "detail": str(e)}
+                self._reply_json(
+                    503 if e.draining else 429, body,
+                    extra_headers=[("Retry-After",
+                                    str(int(retry_after_s)))])
                 return
             except DeadlineExceeded as e:
-                self._reply_json(504, {"error": str(e)})
+                self._reply_json(504, {"error": "deadline_exceeded",
+                                       "detail": str(e)})
+                return
+            except RequestPoisoned as e:
+                self._reply_json(500, {"error": "request_poisoned",
+                                       "attempts": e.attempts,
+                                       "detail": str(e)})
                 return
             except Exception as e:  # noqa: BLE001 — model/device failure
                 log.exception("inference failed")
@@ -210,6 +250,9 @@ def make_handler(service: StereoService,
                 headers.append(("X-Iters-Used", str(result.iters_used)))
             if result.tier is not None:
                 headers.append(("X-Tier", result.tier))
+            if result.degraded:
+                headers.append(("X-Degraded",
+                                f"{result.requested_tier}->{result.tier}"))
             self._reply(200, payload, ctype, extra_headers=headers)
 
     return Handler

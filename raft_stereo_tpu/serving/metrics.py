@@ -87,6 +87,41 @@ class ServingMetrics:
         self.anomalies = r.counter(
             "serve_anomalies_total",
             "anomalies detected (queue saturation, deadline-miss rate)")
+        # Resilience instruments (serving/engine.py supervised recovery +
+        # serving/resilience.py): the failure story's audit trail — every
+        # crashed dispatch must show up as retries that converge, a
+        # poisoned request, or a breaker transition, never as silence.
+        self.retries = r.counter(
+            "serve_retries_total",
+            "requests requeued after a crashed dispatch (each retry hop "
+            "counts once)")
+        self.worker_restarts = r.counter(
+            "serve_worker_restarts_total",
+            "device worker threads restarted by the engine supervisor "
+            "after a dispatch crash")
+        self.poisoned = r.counter(
+            "serve_requests_poisoned_total",
+            "requests failed with the typed RequestPoisoned after "
+            "exhausting their dispatch attempts")
+        self.degraded = r.counter(
+            "serve_requests_degraded_total",
+            "requests answered at a cheaper tier than requested "
+            "(brownout degradation)")
+        self.brownout_level = r.gauge(
+            "serve_brownout_level",
+            "current brownout degradation level (0 = off; each level "
+            "pushes eligible requests one rung down the tier ladder)")
+        self.compiles_cold = r.counter(
+            "serve_compiles_cold_total",
+            "serving executables built by XLA compilation (cold)")
+        self.compiles_warm = r.counter(
+            "serve_compiles_warm_total",
+            "serving executables restored from the persistent disk "
+            "cache (warm — no XLA compile paid)")
+        self._circuit_lock = threading.Lock()
+        self._circuit_by_device: Dict[int, Gauge] = {}
+        self._chaos_lock = threading.Lock()
+        self._chaos_by_kind: Dict[str, Counter] = {}
         # Engine dispatch accounting: serve_batches_total counts device
         # dispatches (the "fewer dispatches than requests" batching win is
         # completed/batches), and the per-batch-size family shows which
@@ -134,6 +169,39 @@ class ServingMetrics:
             "does)")
         self._age_lock = threading.Lock()
         self._last_batch_mono: Optional[float] = None
+
+    def circuit_gauge(self, device_index: int) -> Gauge:
+        """The ``serve_circuit_state{device="N"}`` gauge for one device
+        worker: 0 closed, 1 open (quarantined), 2 half-open (probing)."""
+        with self._circuit_lock:
+            g = self._circuit_by_device.get(device_index)
+            if g is None:
+                g = self.registry.gauge(
+                    "serve_circuit_state",
+                    "per-device circuit breaker state (0 closed, 1 open/"
+                    "quarantined, 2 half-open/probing)",
+                    labels={"device": str(device_index)})
+                self._circuit_by_device[device_index] = g
+        return g
+
+    def observe_injected_fault(self, kind: str) -> None:
+        """Count one injected chaos fault into the per-kind
+        ``serve_chaos_injected_total`` family (serving/chaos.py wires
+        this as the injector's observe hook)."""
+        with self._chaos_lock:
+            c = self._chaos_by_kind.get(kind)
+            if c is None:
+                c = self.registry.counter(
+                    "serve_chaos_injected_total",
+                    "faults injected by the chaos harness, by kind",
+                    labels={"kind": kind})
+                self._chaos_by_kind[kind] = c
+        c.inc()
+
+    def injected_faults(self, kind: str) -> int:
+        with self._chaos_lock:
+            c = self._chaos_by_kind.get(kind)
+        return 0 if c is None else c.value
 
     def observe_dispatch(self, batch_size: int) -> None:
         """Record one device dispatch at ``batch_size`` occupancy: the

@@ -1,0 +1,193 @@
+"""Persistent AOT executable cache: restart-to-ready in seconds, not
+compile-minutes.
+
+COST_REPORT_r10.json measured 23.6 s of XLA compile for the 7-iter
+realtime model *per shape bucket* — and round 11/12 multiplied the
+executable surface to (bucket x batch size x tier).  A crashed or
+rescheduled serving process repays that entire product on boot, which at
+production scale means tens of seconds of dead pod per autoscale event.
+This module makes prewarm disk-bound instead of compile-bound:
+
+* ``ExecutableDiskCache`` — serializes compiled executables
+  (``jax.experimental.serialize_executable``) to a content-addressed
+  file per compile point and loads them back on the next boot.  The key
+  is a SHA-256 over everything that invalidates an executable: jax
+  version, backend platform + version, device kind, the model config
+  JSON, padded shape, batch size, tier knobs, GRU depth, fetch dtype,
+  and donation — a new jax wheel or a config change misses cleanly and
+  recompiles (stale entries are just dead files, never wrong programs).
+* ``enable_persistent_compilation_cache`` — turns on jax's own
+  persistent compilation cache in the same directory, which also covers
+  compiles that do not route through the AOT path.
+
+Degradation contract (same as telemetry/costs.py): serialization that
+fails for any reason — backend without serialization support, pickle
+drift across versions, a corrupt/truncated cache file — logs once and
+falls back to a fresh compile.  The cache can make boot faster; it can
+never make serving wrong or down.  Writes are atomic (tmp +
+``os.replace``) so a crash mid-write cannot leave a torn entry for the
+next boot to trip over.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+# Bump to invalidate every existing cache entry on a format change.
+CACHE_FORMAT_VERSION = 1
+
+
+def backend_fingerprint() -> Dict[str, str]:
+    """The jax/backend identity an executable is only valid under."""
+    import jax
+
+    fp = {"jax": jax.__version__,
+          "cache_format": str(CACHE_FORMAT_VERSION)}
+    try:
+        backend = jax.extend.backend.get_backend()
+        fp["platform"] = str(backend.platform)
+        fp["platform_version"] = str(
+            getattr(backend, "platform_version", ""))
+    except Exception:  # pragma: no cover - exotic backend init
+        fp["platform"] = str(jax.default_backend())
+    try:
+        fp["device_kind"] = str(
+            getattr(jax.devices()[0], "device_kind", ""))
+    except Exception:  # pragma: no cover
+        fp["device_kind"] = ""
+    return fp
+
+
+def executable_cache_key(**coords: Any) -> str:
+    """Stable content key of one compile point: the caller passes every
+    coordinate that selects a distinct program (config JSON, padded
+    shape, batch, tier, iters, fetch dtype, donation, device index) and
+    the backend fingerprint is mixed in here."""
+    payload = dict(coords)
+    payload["backend"] = backend_fingerprint()
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class ExecutableDiskCache:
+    """Directory of serialized compiled executables, keyed by
+    ``executable_cache_key``.
+
+    ``load`` returns a ready-to-call loaded executable or None (miss /
+    unreadable / wrong format — misses never raise).  ``store`` is
+    best-effort and atomic.  A ``disabled`` cache (serialization proved
+    unavailable on this backend) stops trying after the first failure so
+    a hot dispatch path does not repeatedly pay a doomed serialize.
+    """
+
+    def __init__(self, cache_dir: str):
+        self.cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self.disabled = False
+        self.loads = 0       # warm hits served from disk
+        self.stores = 0
+        self.misses = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"{key}.jaxexe")
+
+    def load(self, key: str):
+        if self.disabled:
+            return None
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            return None
+        except Exception:
+            log.warning("unreadable executable cache entry %s; "
+                        "recompiling (entry will be rewritten)", path,
+                        exc_info=True)
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            from jax.experimental import serialize_executable
+            exe = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception:
+            log.warning("could not deserialize cached executable %s "
+                        "(backend/jax drift past the fingerprint?); "
+                        "recompiling", path, exc_info=True)
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.loads += 1
+        return exe
+
+    def store(self, key: str, compiled) -> bool:
+        if self.disabled:
+            return False
+        try:
+            from jax.experimental import serialize_executable
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+        except Exception:
+            log.warning("executable serialization unavailable on this "
+                        "backend; persistent cache disabled for this "
+                        "process", exc_info=True)
+            self.disabled = True
+            return False
+        path = self._path(key)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            log.warning("could not write executable cache entry %s",
+                        path, exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self.stores += 1
+        return True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"loads": self.loads, "stores": self.stores,
+                    "misses": self.misses,
+                    "disabled": int(self.disabled)}
+
+
+def enable_persistent_compilation_cache(cache_dir: str) -> bool:
+    """Point jax's own persistent compilation cache at ``cache_dir`` —
+    covers compiles outside the engine's AOT path (best-effort; False
+    when this jax build does not support it)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(os.path.expanduser(cache_dir)))
+        # Cache every compile, not just the slow ones: serving prewarm is
+        # many medium-size compiles, each below the default 1s floor.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return True
+    except Exception:  # pragma: no cover - older jax
+        log.warning("jax persistent compilation cache unsupported by "
+                    "this jax build", exc_info=True)
+        return False

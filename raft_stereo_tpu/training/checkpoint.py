@@ -9,6 +9,18 @@ making exact resume impossible.  Here a checkpoint directory holds:
   (or params + batch_stats only, for inference exports)
 * ``config.json`` — the model architecture (RaftStereoConfig), so loading
   never requires re-supplying the right CLI flags.
+* ``COMMIT``      — written LAST: its presence marks the checkpoint
+  complete.
+
+Saves are atomic (round 13): everything is written into a same-filesystem
+``<path>.tmp-*`` staging directory, fsynced, stamped with the ``COMMIT``
+marker, and only then moved to its final name with ``os.replace`` (the
+parent directory fsynced after).  A preemption mid-save — the normal way
+TPU VMs die — leaves either the previous checkpoint or a ``.tmp-*``
+orphan, never a torn directory at the final name.  ``latest_checkpoint``
++ ``is_valid_checkpoint`` give the train loop resume-from-latest-valid:
+scan the checkpoint dir, skip staging orphans and anything torn (by
+older non-atomic writers), resume from the newest step that validates.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ log = logging.getLogger(__name__)
 
 CONFIG_FILE = "config.json"
 STATE_DIR = "state"
+COMMIT_FILE = "COMMIT"   # written last; marks the checkpoint complete
 
 
 # ---------------------------------------------------------------- migration
@@ -90,17 +103,140 @@ def _abs(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
 
+def _fsync_dir(path: str) -> None:
+    """Flush a directory entry to disk (rename durability on POSIX); a
+    filesystem that cannot fsync a directory degrades to a no-op."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. non-POSIX filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path: str, model_cfg: RaftStereoConfig,
                     state_tree: Dict[str, Any]) -> None:
-    """Save ``state_tree`` (any pytree of arrays) + the model config."""
+    """Save ``state_tree`` (any pytree of arrays) + the model config,
+    ATOMICALLY: stage into ``<path>.tmp-<pid>``, fsync, mark ``COMMIT``,
+    then ``os.replace`` into place.  A crash at any point leaves the
+    previous checkpoint (or nothing) at ``path`` — never a torn one."""
     path = _abs(path)
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, CONFIG_FILE), "w") as f:
-        f.write(model_cfg.to_json())
-    ckptr = ocp.StandardCheckpointer()
-    state_path = os.path.join(path, STATE_DIR)
-    ckptr.save(state_path, jax.device_get(state_tree), force=True)
-    ckptr.wait_until_finished()
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):   # leftover of a previous crashed save
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        with open(os.path.join(tmp, CONFIG_FILE), "w") as f:
+            f.write(model_cfg.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(tmp, STATE_DIR),
+                   jax.device_get(state_tree), force=True)
+        ckptr.wait_until_finished()
+        commit: Dict[str, Any] = {"complete": True}
+        if "step" in state_tree:   # lets latest_checkpoint rank without
+            try:                   # restoring the whole state tree
+                commit["step"] = int(np.asarray(state_tree["step"]))
+            except (TypeError, ValueError):
+                pass
+        with open(os.path.join(tmp, COMMIT_FILE), "w") as f:
+            json.dump(commit, f)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        if os.path.exists(path):
+            # os.replace cannot clobber a non-empty directory: retire the
+            # old checkpoint first.  Both sides of the tiny window are a
+            # VALID state (old complete, or new complete after the next
+            # rename) — never a torn mixture; the retired copy is removed
+            # only after the new one is in place.
+            retired = f"{path}.old-{os.getpid()}"
+            if os.path.exists(retired):
+                import shutil
+                shutil.rmtree(retired)
+            os.replace(path, retired)
+            os.replace(tmp, path)
+            import shutil
+            shutil.rmtree(retired, ignore_errors=True)
+        else:
+            os.replace(tmp, path)
+        _fsync_dir(parent)
+    except BaseException:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    """Whether ``path`` holds a complete checkpoint: parseable
+    ``config.json`` + a non-empty orbax state dir.  The ``COMMIT`` marker
+    is required only when absent TOGETHER with a suspicious state — all
+    checkpoints written by the atomic saver carry it; pre-round-13
+    checkpoints (no marker, but intact files) still validate."""
+    path = _abs(path)
+    state = os.path.join(path, STATE_DIR)
+    try:
+        with open(os.path.join(path, CONFIG_FILE)) as f:
+            RaftStereoConfig.from_json(f.read())
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    try:
+        if not os.listdir(state):
+            return False
+    except OSError:
+        return False
+    return True
+
+
+def latest_checkpoint(checkpoint_dir: str,
+                      name: Optional[str] = None) -> Optional[str]:
+    """The newest VALID checkpoint under ``checkpoint_dir``, or None.
+
+    The train loop writes ``<step>_<name>`` per validation boundary plus
+    a final/preemption ``<name>``; this scans all of them, skips staging
+    (``.tmp-*``) and retired (``.old-*``) orphans plus anything torn
+    (``is_valid_checkpoint``), and picks by highest saved step —
+    resume-from-latest-valid: a preemption mid-save costs at most the
+    steps since the previous checkpoint, never a crash loop on a torn
+    directory.  ``name`` (optional) restricts to that run's checkpoints.
+    """
+    root = _abs(checkpoint_dir)
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return None
+    best: Optional[str] = None
+    best_key = (-1, -1.0)
+    for entry in entries:
+        if ".tmp-" in entry or ".old-" in entry:
+            continue
+        if name is not None and not (entry == name
+                                     or entry.endswith(f"_{name}")):
+            continue
+        path = os.path.join(root, entry)
+        if not os.path.isdir(path) or not is_valid_checkpoint(path):
+            continue
+        step = -1
+        try:   # the atomic saver records the step in the COMMIT marker
+            with open(os.path.join(path, COMMIT_FILE)) as f:
+                step = int(json.load(f).get("step", -1))
+        except (OSError, ValueError, TypeError):
+            step_prefix = entry.split("_", 1)[0]   # legacy: dir name
+            if step_prefix.isdigit():
+                step = int(step_prefix)
+        key = (step, os.path.getmtime(path))
+        if key > best_key:
+            best, best_key = path, key
+    return best
 
 
 def load_config(path: str) -> RaftStereoConfig:

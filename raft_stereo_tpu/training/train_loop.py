@@ -237,6 +237,21 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
     init_shape = (1, h, w, 3)
     rng = jax.random.PRNGKey(train_cfg.seed)
 
+    if restore == "latest":
+        # Resume-from-latest-valid: scan the checkpoint dir for this
+        # run's newest COMPLETE checkpoint (atomic saves + validity
+        # check, training/checkpoint.py).  A preemption mid-save can
+        # never leave a torn checkpoint at a final name, and anything
+        # torn by an older writer is skipped instead of crash-looping
+        # the restart.
+        restore = ckpt.latest_checkpoint(checkpoint_dir, name=name)
+        if restore is None:
+            log.warning("--restore_ckpt latest: no valid checkpoint "
+                        "under %s for run %r; starting fresh",
+                        checkpoint_dir, name)
+        else:
+            log.info("--restore_ckpt latest resolved to %s", restore)
+
     start_step = 0
     if restore and restore.endswith(".pth"):
         # warm start from a reference torch checkpoint

@@ -1,0 +1,745 @@
+"""Resilience-layer tests (tier-1, CPU).
+
+Policy tests run without JAX: the chaos injector's determinism, the
+circuit-breaker state machine on a fake clock, the brownout controller's
+engage/restore hysteresis on fake metrics, and the requeue path's
+ordering/dedup/no-double-dispatch contract against a bare ``BucketQueue``.
+Engine tests run the REAL tiny model through injected faults: a crashed
+dispatch recovers via retry with the result still matching solo
+inference, exhausted retries poison with the typed error, the no-chaos
+dispatch path stays bitwise-equal to the solo runner, and a warm
+restart restores executables from the persistent disk cache.  Checkpoint
+tests pin the atomic-save contract (a truncated checkpoint can never be
+resumed from; resume-from-latest-valid skips it).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.serving.batcher import (BucketQueue, Overloaded,
+                                             Request, RequestPoisoned)
+from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
+                                           InjectedResourceExhausted,
+                                           InjectedWorkerCrash,
+                                           parse_chaos_spec)
+from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
+                                                CIRCUIT_HALF_OPEN,
+                                                CIRCUIT_OPEN,
+                                                BrownoutController,
+                                                CircuitBreaker, cost_ladder)
+
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+ITERS = 1
+
+
+# ----------------------------------------------------------- chaos injector
+def test_chaos_off_by_default():
+    from raft_stereo_tpu.serving.engine import ServeConfig
+
+    assert ServeConfig().chaos is None
+    assert not ChaosConfig().enabled
+    assert ChaosConfig(crash_rate=0.1).enabled
+
+
+def test_chaos_injector_is_deterministic_per_stream():
+    """Two injectors with the same seed inject the identical fault
+    sequence per (site, worker) stream, independent of the other
+    worker's interleaving — the property chaos CI repros rest on."""
+    def crash_pattern(inj, worker, n=200):
+        out = []
+        for _ in range(n):
+            try:
+                inj.on_dispatch(worker)
+                out.append(False)
+            except InjectedWorkerCrash:
+                out.append(True)
+        return out
+
+    a = ChaosInjector(ChaosConfig(seed=3, crash_rate=0.1))
+    b = ChaosInjector(ChaosConfig(seed=3, crash_rate=0.1))
+    # interleave worker 1 draws on b only: worker 0's stream must not move
+    for _ in range(50):
+        try:
+            b.on_dispatch(1)
+        except InjectedWorkerCrash:
+            pass
+    pa, pb = crash_pattern(a, 0), crash_pattern(b, 0)
+    assert pa == pb
+    assert 5 <= sum(pa) <= 40      # ~10% of 200, loose deterministic band
+    c = ChaosInjector(ChaosConfig(seed=4, crash_rate=0.1))
+    assert crash_pattern(c, 0) != pa   # seed actually matters
+
+
+def test_chaos_injector_respects_device_targeting_and_budget():
+    inj = ChaosInjector(ChaosConfig(seed=0, crash_rate=1.0, devices=(1,),
+                                    max_faults=2))
+    inj.on_dispatch(0)              # untargeted worker: never faults
+    with pytest.raises(InjectedWorkerCrash):
+        inj.on_dispatch(1)
+    with pytest.raises(InjectedWorkerCrash):
+        inj.on_dispatch(1)
+    inj.on_dispatch(1)              # budget exhausted: healthy again
+    assert inj.faults_injected == 2
+
+
+def test_chaos_resource_exhausted_message_matches_xla():
+    inj = ChaosInjector(ChaosConfig(seed=0, resource_exhausted_rate=1.0))
+    with pytest.raises(InjectedResourceExhausted, match="RESOURCE_EXHAUSTED"):
+        inj.on_dispatch(0)
+
+
+def test_parse_chaos_spec():
+    assert parse_chaos_spec(None) is None
+    assert parse_chaos_spec("") is None
+    cc = parse_chaos_spec("crash=0.1,seed=7,latency_ms=50,latency=0.2,"
+                          "devices=0|2,max_faults=5")
+    assert cc == ChaosConfig(seed=7, crash_rate=0.1, latency_rate=0.2,
+                             latency_ms=50.0, devices=(0, 2), max_faults=5)
+    with pytest.raises(ValueError):
+        parse_chaos_spec("bogus=1")
+    with pytest.raises(ValueError):
+        ChaosConfig(crash_rate=1.5)
+
+
+# ---------------------------------------------------------- circuit breaker
+def test_circuit_breaker_state_machine():
+    clock = [0.0]
+    transitions = []
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                        clock=lambda: clock[0],
+                        on_state=lambda o, n, f: transitions.append((o, n)))
+    assert br.state == CIRCUIT_CLOSED and br.until_allowed() == 0.0
+    assert not br.record_failure()          # 1 of 2: still closed
+    br.record_success()                     # success resets the streak
+    assert not br.record_failure()
+    assert br.record_failure()              # 2 consecutive: OPEN
+    assert br.state == CIRCUIT_OPEN
+    assert br.until_allowed() > 0           # quarantined
+    clock[0] = 1.1                          # cooldown over
+    assert br.until_allowed() == 0.0        # the half-open probe token
+    assert br.state == CIRCUIT_HALF_OPEN
+    assert br.until_allowed() > 0           # only ONE probe at a time
+    br.record_failure()                     # probe failed: straight back
+    assert br.state == CIRCUIT_OPEN
+    clock[0] = 2.2
+    assert br.until_allowed() == 0.0
+    br.record_success()                     # probe succeeded
+    assert br.state == CIRCUIT_CLOSED and br.until_allowed() == 0.0
+    assert (CIRCUIT_CLOSED, CIRCUIT_OPEN) in transitions
+    assert (CIRCUIT_HALF_OPEN, CIRCUIT_CLOSED) in transitions
+
+
+# ---------------------------------------------------------------- brownout
+class _FakeCounter:
+    def __init__(self):
+        self.value = 0
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.queue_depth = _FakeCounter()
+        self.admitted = _FakeCounter()
+        self.deadline_missed = _FakeCounter()
+
+
+def test_cost_ladder_orders_cheapest_first():
+    from raft_stereo_tpu.config import parse_tier
+
+    tiers = [parse_tier(s) for s in
+             ("quality", "interactive", "balanced")]
+    assert cost_ladder(tiers) == ["interactive", "balanced", "quality"]
+    inline = [parse_tier(s) for s in ("a:0.2", "b:0.5", "c:0")]
+    assert cost_ladder(inline) == ["b", "a", "c"]
+
+
+def test_brownout_engages_on_saturation_and_restores_with_hysteresis():
+    clock = [0.0]
+    m = _FakeMetrics()
+    bc = BrownoutController(
+        m, max_queue=10, ladder=["interactive", "balanced", "quality"],
+        engage_fraction=0.8, engage_s=1.0, restore_fraction=0.2,
+        restore_s=3.0, clock=lambda: clock[0])
+    assert bc.level == 0
+    assert bc.degrade("quality") == "quality"       # level 0: no-op
+    m.queue_depth.value = 9                          # saturated
+    bc.check()                                       # pressure starts
+    clock[0] = 0.5
+    assert bc.check() == 0                           # not sustained yet
+    clock[0] = 1.2
+    assert bc.check() == 1                           # sustained: engage
+    assert bc.degrade("quality") == "balanced"
+    assert bc.degrade("balanced") == "interactive"
+    assert bc.degrade("interactive") == "interactive"  # floor
+    assert bc.degrade(None) is None                  # off-ladder passes
+    clock[0] = 2.5
+    assert bc.check() == 2                           # still saturated: next rung
+    assert bc.degrade("quality") == "interactive"
+    # mid-band depth (between watermarks) holds the level forever
+    m.queue_depth.value = 5
+    for t in (3.0, 5.0, 9.0, 20.0):
+        clock[0] = t
+        assert bc.check() == 2
+    # calm below the restore watermark, but restore needs restore_s
+    m.queue_depth.value = 1
+    clock[0] = 21.0
+    bc.check()
+    clock[0] = 22.0
+    assert bc.check() == 2                           # only 1s calm
+    clock[0] = 24.1
+    assert bc.check() == 1                           # 3.1s calm: one rung back
+    clock[0] = 27.3
+    assert bc.check() == 0                           # fully restored
+    assert bc.degrade("quality") == "quality"
+
+
+def test_brownout_engages_on_deadline_miss_rate():
+    clock = [0.0]
+    m = _FakeMetrics()
+    bc = BrownoutController(
+        m, max_queue=100, ladder=["interactive", "quality"],
+        engage_fraction=0.9, engage_s=0.5, restore_fraction=0.1,
+        restore_s=2.0, miss_rate=0.5, min_events=4,
+        clock=lambda: clock[0])
+    m.admitted.value, m.deadline_missed.value = 10, 6   # 60% missed
+    bc.check()
+    m.admitted.value, m.deadline_missed.value = 20, 12
+    clock[0] = 0.6
+    assert bc.check() == 1
+
+
+# ------------------------------------------------------------- requeue path
+def _req(bucket=(64, 96), t=None, tier=None):
+    return Request(bucket=bucket, payload=None, future=Future(),
+                   t_enqueue=time.monotonic() if t is None else t,
+                   tier=tier)
+
+
+def test_requeue_rejoins_ahead_of_fresh_requests():
+    """Satellite: a retried (older) request re-enters a bucket FIFO that
+    already holds fresh requests AHEAD of them — a crash must not also
+    cost queue position — and the next pops re-decompose cleanly."""
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), max_queue=16)
+    old = [_req(t=1.0), _req(t=2.0)]
+    for r in old:
+        q.submit(r)
+    batch = q.pop(timeout=5)                 # dispatch picks both up
+    assert batch == old and q.depth == 0
+    fresh = [_req(t=3.0), _req(t=4.0), _req(t=5.0)]
+    for r in fresh:
+        q.submit(r)
+    assert q.requeue(batch) == 2             # crashed dispatch bounces back
+    assert q.depth == 5
+    redo = q.pop(timeout=5)
+    # 5 queued -> batch of 4, admission-ordered: the two retried requests
+    # lead, then the fresh ones
+    assert redo == [old[0], old[1], fresh[0], fresh[1]]
+    assert q.pop(timeout=5) == [fresh[2]]
+
+
+def test_requeue_dedups_and_skips_resolved_requests():
+    """Satellite: no double-dispatch — a request already back in its
+    bucket is not inserted twice, and a request whose future resolved
+    (poisoned / deadline) while it waited for backoff never re-enters."""
+    q = BucketQueue(max_batch=2, batch_sizes=(1, 2), max_queue=8)
+    a, b = _req(t=1.0), _req(t=2.0)
+    q.submit(a), q.submit(b)
+    batch = q.pop(timeout=5)
+    assert batch == [a, b]
+    b.future.set_exception(RequestPoisoned("boom", attempts=2))
+    assert q.requeue(batch) == 1             # only `a` re-enters
+    assert q.requeue(batch) == 0             # double requeue: all dupes
+    assert q.depth == 1
+    assert q.pop(timeout=5) == [a]
+    assert q.depth == 0
+
+
+def test_requeue_interleaves_with_fresh_by_tier_group():
+    """Retried requests only jump the queue within their own
+    (bucket, tier) group — other groups' FIFO order is untouched."""
+    q = BucketQueue(max_batch=2, batch_sizes=(1, 2), max_queue=8)
+    t_a = _req(t=1.0, tier="interactive")
+    q.submit(t_a)
+    batch = q.pop(timeout=5)
+    q.submit(_req(t=2.0, tier="quality"))
+    q.submit(_req(t=3.0, tier="interactive"))
+    assert q.requeue(batch) == 1
+    # oldest-head group wins: the interactive group's head is t=1.0
+    redo = q.pop(timeout=5)
+    assert redo[0] is t_a and all(r.tier == "interactive" for r in redo)
+
+
+def test_requeue_allowed_while_draining_but_not_closed():
+    q = BucketQueue(max_batch=1, batch_sizes=(1,), max_queue=8)
+    r = _req(t=1.0)
+    q.submit(r)
+    batch = q.pop(timeout=5)
+    q.stop_admitting()
+    with pytest.raises(Overloaded):
+        q.submit(_req())                     # fresh work refused
+    assert q.requeue(batch) == 1             # admitted work still retries
+    assert q.pop(timeout=5) == [r]
+    q.close()
+    r2 = _req(t=2.0)
+    assert q.requeue([r2]) == 0              # closed: typed failure instead
+    with pytest.raises(Overloaded):
+        r2.future.result(timeout=1)
+
+
+# ----------------------------------------------------------- engine + chaos
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+def _pairs(n, hw=(48, 64), seed=3):
+    rng = np.random.default_rng(seed)
+    lefts = [rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+             for _ in range(n)]
+    rights = [np.roll(l, -3, axis=1) for l in lefts]
+    return lefts, rights
+
+
+def test_engine_recovers_crashed_dispatch_with_retry(tiny_model):
+    """The headline recovery property: an injected crash mid-dispatch
+    requeues the request, a fresh worker picks it up, and the answer is
+    STILL bitwise-equal to solo inference — the client sees a slower
+    response, never a broken one."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    want, _ = solo(lefts[0], rights[0])
+    chaos = ChaosConfig(seed=1, crash_rate=1.0, max_faults=1)
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS, chaos=chaos,
+            max_dispatch_attempts=3, retry_backoff_ms=1.0)) as svc:
+        res = svc.infer(lefts[0], rights[0], timeout=300)
+        assert res.attempts == 2
+        assert np.array_equal(res.flow, want)
+        assert svc.metrics.retries.value == 1
+        assert svc.metrics.worker_restarts.value == 1
+        assert svc.metrics.injected_faults("crash") == 1
+        assert svc.metrics.completed.value == 1
+        assert svc.metrics.poisoned.value == 0
+
+
+def test_engine_poisons_after_exhausted_attempts(tiny_model):
+    """A request that crashes on every bounded attempt fails individually
+    with the typed RequestPoisoned — the server survives, the ledger
+    balances, and a subsequent request (faults exhausted) succeeds."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    chaos = ChaosConfig(seed=1, crash_rate=1.0, max_faults=2)
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS, chaos=chaos,
+            max_dispatch_attempts=2, retry_backoff_ms=1.0,
+            breaker_failures=5, breaker_cooldown_s=0.05)) as svc:
+        with pytest.raises(RequestPoisoned) as ei:
+            svc.infer(lefts[0], rights[0], timeout=300)
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.last_error, InjectedWorkerCrash)
+        assert svc.metrics.poisoned.value == 1
+        assert svc.metrics.failed.value == 1
+        # faults exhausted: the engine still serves
+        res = svc.infer(lefts[0], rights[0], timeout=300)
+        assert res.attempts == 1
+        assert svc.metrics.completed.value == 1
+
+
+def test_engine_circuit_breaker_quarantines_and_recovers(tiny_model):
+    """The flapping-device story: consecutive failures open the device's
+    circuit (gauge -> open), the cooldown's half-open probe succeeds once
+    the flap ends, and the circuit closes — with every request answered."""
+    from raft_stereo_tpu.serving import (CIRCUIT_CLOSED, ServeConfig,
+                                         StereoService)
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(2)
+    fired = []
+
+    class Sink:
+        def fire(self, kind, **detail):
+            fired.append(kind)
+
+    chaos = ChaosConfig(seed=2, crash_rate=1.0, max_faults=2)
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS, chaos=chaos,
+            max_dispatch_attempts=4, retry_backoff_ms=1.0,
+            breaker_failures=2, breaker_cooldown_s=0.1)) as svc:
+        svc.attach_anomaly_sink(Sink())
+        svc.prewarm((48, 64))
+        futs = [svc.submit(l, r) for l, r in zip(lefts, rights)]
+        results = [f.result(timeout=300) for f in futs]
+        assert all(np.isfinite(r.flow).all() for r in results)
+        assert "circuit_open" in fired
+        assert "circuit_closed" in fired
+        assert fired.index("circuit_closed") > fired.index("circuit_open")
+        assert "worker_crash" in fired
+        assert svc.metrics.circuit_gauge(0).value == CIRCUIT_CLOSED
+
+
+def test_engine_no_chaos_dispatch_bitwise_unchanged(tiny_model):
+    """The zero-overhead contract: chaos unset (and even a ChaosConfig
+    with all rates 0) leaves the dispatch path producing bitwise the
+    solo runner's output, with no retries, restarts, or injections."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(2)
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    for chaos in (None, ChaosConfig()):   # unset and rate-0 both inert
+        with StereoService(cfg, variables, ServeConfig(
+                max_batch=1, batch_sizes=(1,), iters=ITERS,
+                chaos=chaos)) as svc:
+            assert svc.chaos is None      # rate-0 config never arms
+            for l, r in zip(lefts, rights):
+                res = svc.infer(l, r, timeout=300)
+                want, _ = solo(l, r)
+                assert np.array_equal(res.flow, want)
+                assert res.attempts == 1
+            m = svc.metrics
+            assert (m.retries.value == m.worker_restarts.value
+                    == m.poisoned.value == 0)
+
+
+def test_engine_brownout_degrades_and_labels_results(tiny_model):
+    """Brownout at level 1 reroutes an eligible quality request one rung
+    down the ladder (result labeled with requested_tier/degraded), honors
+    degradable=False and exempt tiers, and serves as-requested at
+    level 0."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=4,
+            tiers=("interactive:7.0:2", "balanced:3.0:2", "quality"),
+            brownout=True, brownout_exempt_tiers=("interactive",),
+            brownout_poll_s=3600.0)) as svc:   # poll inert: tests drive it
+        assert svc.brownout is not None
+        assert svc.brownout.ladder == ("interactive", "balanced",
+                                       "quality")
+        res = svc.infer(lefts[0], rights[0], tier="quality", timeout=300)
+        assert res.tier == "quality" and not res.degraded
+        with svc.brownout._lock:
+            svc.brownout._set_level(1, "test")
+        res = svc.infer(lefts[0], rights[0], tier="quality", timeout=300)
+        assert res.tier == "balanced" and res.degraded
+        assert res.requested_tier == "quality"
+        res = svc.infer(lefts[0], rights[0], tier="quality",
+                        degradable=False, timeout=300)
+        assert res.tier == "quality" and not res.degraded
+        res = svc.infer(lefts[0], rights[0], tier="interactive",
+                        timeout=300)   # exempt tier: never degraded
+        assert res.tier == "interactive" and not res.degraded
+        assert svc.metrics.degraded.value == 1
+        assert svc.metrics.brownout_level.value == 1
+
+
+def test_serve_config_validates_resilience_knobs():
+    from raft_stereo_tpu.serving.engine import ServeConfig
+
+    with pytest.raises(ValueError, match="max_dispatch_attempts"):
+        ServeConfig(max_dispatch_attempts=0)
+    with pytest.raises(ValueError, match="breaker_failures"):
+        ServeConfig(breaker_failures=0)
+    with pytest.raises(ValueError, match="two configured tiers"):
+        ServeConfig(brownout=True)
+    with pytest.raises(ValueError, match="brownout_exempt_tiers"):
+        ServeConfig(tiers=("interactive", "quality"),
+                    brownout_exempt_tiers=("nope",))
+    # valid combined config constructs
+    ServeConfig(tiers=("interactive", "quality"), brownout=True,
+                brownout_exempt_tiers=("quality",),
+                chaos=ChaosConfig(crash_rate=0.5),
+                max_dispatch_attempts=3)
+
+
+# ----------------------------------------------------- persistent exe cache
+def test_executable_disk_cache_roundtrip_and_corruption(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.serving.persist import (ExecutableDiskCache,
+                                                 executable_cache_key)
+
+    cache = ExecutableDiskCache(str(tmp_path / "exe"))
+    key = executable_cache_key(config="{}", bucket=(4, 4), batch=1,
+                               tier=None, iters=1, fetch_dtype=None,
+                               donate=False, device="0")
+    assert cache.load(key) is None and cache.misses == 1
+    compiled = jax.jit(lambda x: x * 2 + 1).lower(
+        jnp.ones((4, 4))).compile()
+    assert cache.store(key, compiled)
+    exe = cache.load(key)
+    assert exe is not None and cache.loads == 1
+    np.testing.assert_array_equal(np.asarray(exe(jnp.ones((4, 4)))),
+                                  np.full((4, 4), 3.0))
+    # a truncated/corrupt entry degrades to a miss, never an error
+    path = cache._path(key)
+    with open(path, "wb") as f:
+        f.write(b"torn")
+    assert cache.load(key) is None
+    # different coordinates -> different key (no false sharing)
+    key2 = executable_cache_key(config="{}", bucket=(4, 4), batch=2,
+                                tier=None, iters=1, fetch_dtype=None,
+                                donate=False, device="0")
+    assert key2 != key
+
+
+@pytest.mark.slow
+def test_engine_warm_restart_restores_from_disk(tiny_model, tmp_path):
+    """Cold boot compiles + stores; a second engine over the same cache
+    dir restores every executable (compiles_warm == cold's compiles_cold,
+    zero cold compiles) and serves bitwise-identical results."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    sc = ServeConfig(max_batch=1, batch_sizes=(1,), iters=ITERS,
+                     executable_cache_dir=str(tmp_path / "exe"),
+                     warmup_shapes=((48, 64),))
+    with StereoService(cfg, variables, sc) as svc:
+        assert svc.ready
+        n_cold = svc.metrics.compiles_cold.value
+        assert n_cold >= 1 and svc.metrics.compiles_warm.value == 0
+        res_cold = svc.infer(lefts[0], rights[0], timeout=300)
+    with StereoService(cfg, variables, sc) as svc:
+        assert svc.ready
+        assert svc.metrics.compiles_warm.value == n_cold
+        assert svc.metrics.compiles_cold.value == 0
+        res_warm = svc.infer(lefts[0], rights[0], timeout=300)
+        assert np.array_equal(res_warm.flow, res_cold.flow)
+
+
+def test_engine_readiness_gates_on_declared_warm_surface(tiny_model):
+    """prewarm_on_init=False: the engine declares its warm surface but is
+    NOT ready until prewarm covers it; without warmup_shapes it is ready
+    at boot (no declared surface)."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS,
+            warmup_shapes=((48, 64),), prewarm_on_init=False)) as svc:
+        assert not svc.ready
+        st = svc.warm_status()
+        assert st["warm_done"] == 0 and st["warm_target"] == 1
+        svc.prewarm((48, 64))
+        assert svc.ready
+        assert svc.warm_status()["warm_done"] == 1
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS)) as svc:
+        assert svc.ready                    # nothing declared = ready
+
+
+# -------------------------------------------------------------- HTTP layer
+def _post(url, body, content_type="application/x-npz", headers=()):
+    req = urllib.request.Request(url, data=body, method="POST")
+    req.add_header("Content-Type", content_type)
+    for k, v in headers:
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _npz(left, right):
+    buf = io.BytesIO()
+    np.savez(buf, left=left, right=right)
+    return buf.getvalue()
+
+
+def test_http_overload_carries_retry_after_and_typed_body(tiny_model):
+    """Satellite: 429 (queue full) and 503 (draining) both carry a
+    Retry-After header and the machine-readable
+    {"error": "overloaded", "retry_after_s": ...} body."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    lefts, rights = _pairs(1)
+    body = _npz(lefts[0], rights[0])
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=1, batch_sizes=(1,),
+                                    iters=ITERS, max_queue=1))
+    server = StereoHTTPServer(svc, port=0).start()
+    try:
+        svc.queue.pause()                  # stage: fill the 1-deep queue
+        t = threading.Thread(
+            target=_post, args=(server.url + "/v1/disparity", body),
+            daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while svc.queue.depth < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        status, headers, resp = _post(server.url + "/v1/disparity", body)
+        assert status == 429
+        assert "Retry-After" in headers
+        payload = json.loads(resp)
+        assert payload["error"] == "overloaded"
+        assert payload["retry_after_s"] > 0
+        assert payload["draining"] is False
+        svc.queue.resume()
+        t.join(timeout=300)
+        svc.queue.stop_admitting()         # draining flavor
+        status, headers, resp = _post(server.url + "/v1/disparity", body)
+        assert status == 503
+        assert "Retry-After" in headers
+        payload = json.loads(resp)
+        assert payload["error"] == "overloaded"
+        assert payload["draining"] is True
+        assert payload["retry_after_s"] >= 1
+    finally:
+        server.shutdown()
+        svc.close()
+
+
+def test_http_liveness_readiness_split(tiny_model):
+    """/healthz (liveness) answers 200 while warming; /readyz is 503
+    with warm progress until the declared ladder is warm, then 200."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=ITERS,
+        warmup_shapes=((48, 64),), prewarm_on_init=False))
+    server = StereoHTTPServer(svc, port=0).start()
+    try:
+        with urllib.request.urlopen(server.url + "/healthz",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["ready"] is False
+        try:
+            with urllib.request.urlopen(server.url + "/readyz",
+                                        timeout=30) as resp:
+                raise AssertionError(
+                    f"/readyz must 503 while warming, got {resp.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            ready = json.loads(e.read())
+            assert ready["status"] == "warming"
+            assert ready["warm_done"] == 0 and ready["warm_target"] == 1
+        svc.prewarm((48, 64))
+        with urllib.request.urlopen(server.url + "/readyz",
+                                    timeout=30) as resp:
+            ready = json.loads(resp.read())
+        assert resp.status == 200 and ready["status"] == "ready"
+    finally:
+        server.shutdown()
+        svc.close()
+
+
+# --------------------------------------------------------- atomic checkpoint
+def _tiny_cfg():
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    return RaftStereoConfig(**TINY)
+
+
+def test_checkpoint_save_is_atomic_and_committed(tmp_path):
+    from raft_stereo_tpu.training import checkpoint as ckpt
+
+    cfg = _tiny_cfg()
+    tree = {"params": {"w": np.arange(4.0)}, "step": np.asarray(7)}
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(path, cfg, tree)
+    assert ckpt.is_valid_checkpoint(path)
+    with open(os.path.join(path, ckpt.COMMIT_FILE)) as f:
+        commit = json.load(f)
+    assert commit["complete"] is True and commit["step"] == 7
+    # no staging/retired orphans left behind
+    assert [e for e in os.listdir(tmp_path)] == ["ck"]
+    _, restored = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(restored["params"]["w"],
+                                  np.arange(4.0))
+    # overwrite in place (the train loop's final checkpoint) stays atomic
+    tree2 = {"params": {"w": np.arange(4.0) + 1}, "step": np.asarray(8)}
+    ckpt.save_checkpoint(path, cfg, tree2)
+    _, restored = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(restored["params"]["w"],
+                                  np.arange(4.0) + 1)
+    assert [e for e in os.listdir(tmp_path)] == ["ck"]
+
+
+def test_truncated_checkpoint_is_invalid_and_skipped(tmp_path):
+    """Regression: the torn-save shapes an unexpected kill used to
+    produce — truncated config.json, missing/empty state — must fail
+    validation, and resume-from-latest must fall back to the previous
+    valid checkpoint instead of crash-looping."""
+    from raft_stereo_tpu.training import checkpoint as ckpt
+
+    cfg = _tiny_cfg()
+    good = str(tmp_path / "100_run")
+    ckpt.save_checkpoint(good, cfg,
+                         {"params": {"w": np.zeros(2)},
+                          "step": np.asarray(100)})
+    torn = str(tmp_path / "200_run")
+    ckpt.save_checkpoint(torn, cfg,
+                         {"params": {"w": np.zeros(2)},
+                          "step": np.asarray(200)})
+    # tear it the old-fashioned way: truncate config.json mid-write
+    with open(os.path.join(torn, ckpt.CONFIG_FILE), "w") as f:
+        f.write('{"hidden_di')
+    assert not ckpt.is_valid_checkpoint(torn)
+    assert ckpt.latest_checkpoint(str(tmp_path), name="run") == good
+    # a staging orphan (crash mid-save) is never a candidate
+    os.makedirs(str(tmp_path / "300_run.tmp-123"))
+    assert ckpt.latest_checkpoint(str(tmp_path), name="run") == good
+    # empty state dir is torn too
+    empty = str(tmp_path / "400_run")
+    ckpt.save_checkpoint(empty, cfg, {"params": {"w": np.zeros(2)},
+                                      "step": np.asarray(400)})
+    state = os.path.join(empty, ckpt.STATE_DIR)
+    import shutil
+    shutil.rmtree(state)
+    os.makedirs(state)
+    assert not ckpt.is_valid_checkpoint(empty)
+    assert ckpt.latest_checkpoint(str(tmp_path), name="run") == good
+
+
+def test_latest_checkpoint_prefers_highest_step(tmp_path):
+    from raft_stereo_tpu.training import checkpoint as ckpt
+
+    cfg = _tiny_cfg()
+    for step in (100, 300, 200):
+        ckpt.save_checkpoint(str(tmp_path / f"{step}_run"), cfg,
+                             {"params": {"w": np.zeros(2)},
+                              "step": np.asarray(step)})
+    assert ckpt.latest_checkpoint(str(tmp_path), name="run") == str(
+        tmp_path / "300_run")
+    # the final/preemption checkpoint (no step prefix) wins when its
+    # COMMIT step is the highest — the actual preemption-resume case
+    ckpt.save_checkpoint(str(tmp_path / "run"), cfg,
+                         {"params": {"w": np.zeros(2)},
+                          "step": np.asarray(350)})
+    assert ckpt.latest_checkpoint(str(tmp_path), name="run") == str(
+        tmp_path / "run")
+    assert ckpt.latest_checkpoint(str(tmp_path), name="other") is None
