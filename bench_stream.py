@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Streaming stereo bench: steady-state warm-session FPS vs cold
+per-frame FPS, plus the warm-start EPE drift that bounds the win.
+
+The round-14 streaming sessions exist for exactly one claim: on a
+temporally coherent sequence, seeding the GRU from the previous frame's
+disparity (RAFT's warm start, arXiv 2109.07547 §3) lets the round-12
+convergence gate stall after a FRACTION of the cold iterations — so
+steady-state video FPS beats cold per-frame FPS via reduced
+``iters_used``, not via a different program.  This bench measures that
+claim end to end and writes the record the acceptance bar reads
+(``STREAM_<tag>.json``):
+
+1. brief-train the hermetic tiny architecture (tools/early_exit_report's
+   exact recipe — an untrained GRU's update magnitudes are meaningless,
+   so its convergence gate is too);
+2. synthesize a VIDEO: a textured scene with known disparity, panned a
+   few pixels per frame (``np.roll`` keeps the ground truth exact), with
+   an optional hard scene cut in the middle;
+3. runner-level measurement (``InferenceRunner.run_stream``): the same
+   early-exit runner does a cold pass (every frame zero-init — the
+   stateless baseline any per-frame client gets) and a warm pass (state
+   chained frame to frame).  Reported: per-pass FPS, mean ``iters_used``,
+   EPE vs ground truth, and the warm−cold EPE drift per frame;
+4. engine-level measurement: the same frames through
+   ``ServingEngine.submit_session`` (the full session/queue/dispatch
+   path) vs stateless ``submit`` — the number a video client actually
+   sees at the HTTP door;
+5. the four synthetic validators run through
+   ``eval.validate.sequence_drift`` (the evaluate.py --sequence mode) —
+   warm-start drift on NON-sequence frames, i.e. the adversarial bound
+   the scene-cut fallback protects.
+
+Acceptance (ISSUE 9): steady-state warm FPS >= 1.5x cold per-frame FPS
+on CPU, drift bounded and reported.  The bench prints the bar verdict
+and records ``meets_1_5x_bar``.
+
+Run from the repo root (CPU fine; ~2-4 min at the defaults):
+
+    JAX_PLATFORMS=cpu python bench_stream.py
+    JAX_PLATFORMS=cpu python bench_stream.py --steps 40 --frames 10 \\
+        --out /tmp/STREAM_smoke.json                       # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+DEFAULT_TAG = "r14"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--frames", type=int, default=12,
+                   help="video frames per measured pass")
+    p.add_argument("--hw", default="96x128", help="frame size HxW")
+    p.add_argument("--pan_px", type=int, default=2,
+                   help="horizontal camera pan per frame (px)")
+    p.add_argument("--scene_cut_at", type=int, default=-1,
+                   help="inject a hard scene cut at this frame index "
+                        "(< 0 disables — the default measures a clean "
+                        "coherent stream)")
+    p.add_argument("--iters", type=int, default=16,
+                   help="GRU depth cap; also the FIXED depth of the "
+                        "cold per-frame baseline row (the stateless "
+                        "quality protocol; the repo CLIs default to 32)")
+    p.add_argument("--threshold", type=float, default=2.0,
+                   help="exit_threshold_px for the gated rows.  2.0 px "
+                        "is the stable operating point for warm-start "
+                        "CHAINING on these briefly-trained weights: "
+                        "tighter gates (0.3-1.0) make the weakly-"
+                        "trained GRU run LONGER from a warm init, not "
+                        "shorter (measured; see notes in the record) — "
+                        "production thresholds on converged checkpoints "
+                        "sit far tighter")
+    p.add_argument("--min_iters", type=int, default=1,
+                   help="early-exit floor — warm frames bottom out here")
+    p.add_argument("--steps", type=int, default=200,
+                   help="brief-training steps before measuring")
+    p.add_argument("--train_hw", default="32x48")
+    p.add_argument("--train_iters", type=int, default=4)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed passes per mode (FPS = best pass, the "
+                        "bench.py convention for CPU noise)")
+    p.add_argument("--validator_images", type=int, default=3,
+                   help="images per synthetic validator tree for the "
+                        "sequence_drift rows")
+    p.add_argument("--skip_engine", action="store_true",
+                   help="skip the engine-level session measurement")
+    p.add_argument("--skip_validators", action="store_true",
+                   help="skip the synthetic-validator drift rows")
+    p.add_argument("--tag", default=DEFAULT_TAG)
+    p.add_argument("--out", default=None,
+                   help="output path; default STREAM_<tag>.json")
+    return p
+
+
+def make_video(rng, n_frames: int, hw, pan_px: int, cut_at):
+    """A synthetic stereo video with exact ground truth: one textured
+    scene + disparity field panned ``pan_px`` px/frame (np.roll keeps
+    the warp geometry exact), with an optional hard scene cut (a fresh
+    scene) at ``cut_at``.  Returns [(left, right, gt_flow)]."""
+    from golden_data import disparity_field, textured_image, warp_right
+
+    h, w = hw
+    frames = []
+    scenes = [(textured_image(rng, h, w), disparity_field(rng, h, w))]
+    if cut_at is not None and 0 < cut_at < n_frames:
+        scenes.append((textured_image(rng, h, w),
+                       disparity_field(rng, h, w)))
+    for t in range(n_frames):
+        scene = scenes[-1] if (cut_at is not None and 0 < cut_at <= t) \
+            else scenes[0]
+        base_t = t - cut_at if (cut_at is not None and 0 < cut_at <= t) \
+            else t
+        left = np.roll(scene[0], -pan_px * base_t, axis=1)
+        disp = np.roll(scene[1], -pan_px * base_t, axis=1)
+        right = warp_right(left, disp)
+        frames.append((left.astype(np.uint8), right.astype(np.uint8),
+                       -disp.astype(np.float32)))
+    return frames
+
+
+def _epe(flow_pr, flow_gt) -> float:
+    return float(np.mean(np.abs(flow_pr - flow_gt)))
+
+
+def runner_pass(runner, frames, warm: bool, cap: int):
+    """One pass over the video: returns (seconds list, iters list,
+    per-frame EPE list).  Warm chains the state with the keyframe guard
+    (a warm frame that ran to the cap drops its state — the serving
+    engine's ``session_reseed_on_cap`` policy); cold zero-inits every
+    frame.  Frame timings use the runner's own fetch-stop clock."""
+    runner.reset_iters_used()
+    state = None
+    secs, iters, epes = [], [], []
+    for left, right, gt in frames:
+        frame = runner.run_stream(left, right,
+                                  prev_flow_low=state if warm else None)
+        if warm:
+            state = (None if (frame.warm and frame.iters_used is not None
+                              and frame.iters_used >= cap)
+                     else frame.flow_low)
+        secs.append(frame.seconds)
+        iters.append(frame.iters_used if frame.iters_used is not None
+                     else cap)
+        epes.append(_epe(frame.flow, gt))
+    return secs, iters, epes
+
+
+def measure_runner(cfg, variables, frames, args) -> dict:
+    """The headline table, three rows over the same video:
+
+    * ``fixed`` — the stateless per-frame protocol: fixed GRU depth
+      ``--iters``, zero init every frame (what every repo CLI and the
+      serving quality tier run today) — the COLD PER-FRAME baseline;
+    * ``cold_gated`` — the round-12 convergence gate, still zero init
+      every frame (stateless early exit — the intermediate point);
+    * ``warm`` — streaming sessions: gate + state chained frame to
+      frame with the keyframe guard.
+
+    FPS is the best of ``--repeats`` steady-state passes (programs
+    precompiled before the clock starts)."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    fixed = InferenceRunner(cfg, variables, iters=args.iters)
+    gated = InferenceRunner(cfg, variables, iters=args.iters,
+                            exit_threshold_px=args.threshold,
+                            exit_min_iters=args.min_iters)
+    # Absorb every program compile (fixed, gated-cold, gated-warm).
+    for r in (fixed, gated):
+        c0 = r.run_stream(frames[0][0], frames[0][1])
+        r.run_stream(frames[0][0], frames[0][1],
+                     prev_flow_low=np.zeros_like(c0.flow_low))
+
+    modes = {"fixed": (fixed, False), "cold_gated": (gated, False),
+             "warm": (gated, True)}
+    rows, per_frame = {}, {}
+    for mode, (runner, warm) in modes.items():
+        best = None
+        for _ in range(max(1, args.repeats)):
+            secs, iters, epes = runner_pass(runner, frames, warm,
+                                            args.iters)
+            fps = len(secs) / sum(secs)
+            if best is None or fps > best[0]:
+                best = (fps, secs, iters, epes)
+        fps, secs, iters, epes = best
+        per_frame[mode] = {"iters": iters, "epe": epes}
+        rows[mode] = {
+            "fps": round(fps, 3),
+            "mean_ms_per_frame": round(1e3 * float(np.mean(secs)), 2),
+            "mean_iters_used": round(float(np.mean(iters)), 3),
+            "per_frame_iters": iters,
+            "epe_mean": round(float(np.mean(epes)), 4),
+            "epe_max": round(float(np.max(epes)), 4),
+        }
+        print(json.dumps({f"runner_{mode}": rows[mode]}), flush=True)
+    for base in ("fixed", "cold_gated"):
+        drift = [w - c for w, c in zip(per_frame["warm"]["epe"],
+                                       per_frame[base]["epe"])]
+        rows[f"warm_drift_epe_vs_{base}"] = {
+            "mean": round(float(np.mean(drift)), 4),
+            "max": round(float(np.max(drift)), 4),
+            "per_frame": [round(d, 4) for d in drift],
+        }
+    # The acceptance ratio: warm sessions vs the cold per-frame
+    # fixed-depth protocol (the win is reduced iters_used through the
+    # same gate — cold_gated is reported so the two mechanisms' shares
+    # are separable).
+    rows["speedup"] = round(rows["warm"]["fps"] / rows["fixed"]["fps"], 3)
+    rows["speedup_vs_cold_gated"] = round(
+        rows["warm"]["fps"] / rows["cold_gated"]["fps"], 3)
+    rows["iters_fraction"] = round(
+        rows["warm"]["mean_iters_used"]
+        / rows["fixed"]["mean_iters_used"], 3)
+    return rows
+
+
+def measure_engine(cfg, variables, frames, args) -> dict:
+    """The same video through the full serving stack: stateless
+    ``submit`` at the quality tier (the fixed-depth cold per-frame
+    protocol — what a sessionless video client gets today) vs
+    ``submit_session`` at the gated stream tier — queue, dispatch,
+    session bookkeeping and all."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    tier = f"stream:{args.threshold}:{args.min_iters}"
+    hw = frames[0][0].shape[:2]
+    out = {}
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=args.iters,
+            sessions=True, session_ttl_s=600.0,
+            tiers=(tier, "quality"), default_tier="quality",
+            warmup_shapes=(hw,))) as svc:
+        # steady state: warm-up frame 0 of each mode outside the clock
+        svc.infer(frames[0][0], frames[0][1], timeout=600)
+        t0 = time.perf_counter()
+        for left, right, _ in frames:
+            svc.infer(left, right, timeout=600)      # quality tier, cold
+        cold_s = time.perf_counter() - t0
+        svc.infer_session("bench", frames[0][0], frames[0][1],
+                          tier="stream", timeout=600)
+        t0 = time.perf_counter()
+        results = [svc.infer_session("bench", left, right, tier="stream",
+                                     timeout=600)
+                   for left, right, _ in frames]
+        warm_s = time.perf_counter() - t0
+        out = {
+            "cold_fps": round(len(frames) / cold_s, 3),
+            "warm_fps": round(len(frames) / warm_s, 3),
+            "speedup": round(cold_s / warm_s, 3),
+            "warm_frames": sum(1 for r in results if r.warm),
+            "scene_cut_frames": sum(1 for r in results if r.scene_cut),
+            "reseeds": svc.metrics.session_reseeds.value,
+            "mean_iters_warm": round(float(np.mean(
+                [r.iters_used for r in results])), 3),
+            "session_stats": svc.close_session("bench"),
+        }
+    print(json.dumps({"engine_sessions": out}), flush=True)
+    return out
+
+
+def validator_drift(cfg, variables, args) -> dict:
+    """evaluate.py --sequence over the four synthetic validator trees:
+    warm-start drift on UNRELATED consecutive frames — the adversarial
+    bound (tools/early_exit_report builds the same trees)."""
+    import tempfile
+
+    from early_exit_report import VALIDATORS, build_benchmarks
+    from raft_stereo_tpu.data import datasets as ds
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.eval.validate import sequence_drift
+
+    hw = tuple(int(x) for x in args.hw.split("x"))
+    runner = InferenceRunner(cfg, variables, iters=args.iters,
+                             exit_threshold_px=args.threshold,
+                             exit_min_iters=args.min_iters)
+    rows = {}
+    with tempfile.TemporaryDirectory() as work:
+        root = os.path.join(work, "datasets")
+        build_benchmarks(root, n=args.validator_images, hw=hw)
+        datasets = {
+            "eth3d": ds.ETH3D(root=os.path.join(root, "ETH3D")),
+            "kitti": ds.KITTI(root=os.path.join(root, "KITTI")),
+            "things": ds.SceneFlow(root=root, dstype="frames_finalpass",
+                                   things_test=True),
+            "middleburyH": ds.Middlebury(
+                root=os.path.join(root, "Middlebury"), split="H"),
+        }
+        for name in VALIDATORS:
+            rows[name] = {
+                k: round(v, 4) for k, v in
+                sequence_drift(runner, datasets[name], name).items()}
+    return rows
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    hw = tuple(int(x) for x in args.hw.split("x"))
+    train_hw = tuple(int(x) for x in args.train_hw.split("x"))
+    cut_at = (args.frames // 2 if args.scene_cut_at is None
+              else (None if args.scene_cut_at < 0 else args.scene_cut_at))
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+    from early_exit_report import (init_variables, model_config,
+                                   trained_variables)
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
+    cfg = model_config()
+    t0 = time.perf_counter()
+    if args.steps > 0:
+        variables = trained_variables(cfg, args.steps, train_hw,
+                                      args.train_iters)
+    else:
+        variables = init_variables(cfg)
+    train_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(17)
+    frames = make_video(rng, args.frames, hw, args.pan_px, cut_at)
+
+    runner_rows = measure_runner(cfg, variables, frames, args)
+    engine_rows = (None if args.skip_engine
+                   else measure_engine(cfg, variables, frames, args))
+    validator_rows = (None if args.skip_validators
+                      else validator_drift(cfg, variables, args))
+
+    meets_bar = runner_rows["speedup"] >= 1.5
+    if not meets_bar:
+        print(f"WARNING: warm/cold FPS ratio {runner_rows['speedup']} "
+              f"< 1.5x acceptance bar", flush=True)
+
+    rec = bench_record({
+        "metric": "stream_warm_vs_cold_fps",
+        "value": runner_rows["speedup"],
+        "unit": f"steady-state warm-session FPS / cold per-frame "
+                f"fixed-depth FPS ({hw[0]}x{hw[1]}, depth {args.iters}, "
+                f"gate {args.threshold} px, CPU)",
+        "platform": jax.devices()[0].platform,
+        "model_config": cfg.to_dict(),
+        "frames": args.frames,
+        "pan_px": args.pan_px,
+        "scene_cut_at": cut_at,
+        "iters_cap": args.iters,
+        "exit_threshold_px": args.threshold,
+        "min_iters": args.min_iters,
+        "train_steps": args.steps,
+        "train_seconds": round(train_s, 1),
+        "runner": runner_rows,
+        "engine_sessions": engine_rows,
+        "validator_sequence_drift": validator_rows,
+        "meets_1_5x_bar": meets_bar,
+        "notes": "synthetic panned-scene video with exact ground truth "
+                 "(tests/golden_data.py geometry) on briefly-trained "
+                 "weights; CPU numbers acceptable per ROADMAP (TPU "
+                 "pending).  The warm win is reduced iters_used through "
+                 "the round-12 convergence gate, not a different "
+                 "program — cold-frame outputs are bitwise-pinned to "
+                 "the sessionless path by tests/test_sessions.py.  "
+                 "Briefly-trained caveat: this GRU is not contractive "
+                 "from warm inits at tight gates (0.3-1.0 px chains "
+                 "DIVERGE — measured), so the bench runs the loose "
+                 "2.0 px stable point and the keyframe guard "
+                 "(session_reseed_on_cap) bounds chain drift; fully "
+                 "trained checkpoints warm-start at production "
+                 "thresholds (arXiv 2109.07547 §3).",
+    })
+    out = args.out or os.path.join(_REPO, f"STREAM_{args.tag}.json")
+    write_record(out, rec, indent=1)
+    print(json.dumps({
+        "metric": "stream_warm_vs_cold_fps",
+        "speedup": runner_rows["speedup"],
+        "speedup_vs_cold_gated": runner_rows["speedup_vs_cold_gated"],
+        "iters_fraction": runner_rows["iters_fraction"],
+        "drift_mean_vs_fixed":
+            runner_rows["warm_drift_epe_vs_fixed"]["mean"],
+        "meets_1_5x_bar": meets_bar, "out": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

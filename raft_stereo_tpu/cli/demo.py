@@ -15,6 +15,7 @@ import argparse
 import glob
 import logging
 import os
+import time
 
 import numpy as np
 
@@ -52,31 +53,74 @@ def run_demo(args) -> int:
 
     out_dir = args.output_directory
     os.makedirs(out_dir, exist_ok=True)
-    left_images = sorted(glob.glob(args.left_imgs, recursive=True))
+    sequence = args.sequence is not None
+    left_glob = (args.sequence if isinstance(args.sequence, str)
+                 else args.left_imgs)
+    left_images = sorted(glob.glob(left_glob, recursive=True))
     right_images = sorted(glob.glob(args.right_imgs, recursive=True))
     if len(left_images) != len(right_images) or not left_images:
         raise SystemExit(
             f"found {len(left_images)} left / {len(right_images)} right "
             "images — globs must match pairwise")
-    log.info("found %d image pairs; writing to %s", len(left_images), out_dir)
+    log.info("found %d image pairs; writing to %s%s", len(left_images),
+             out_dir, " (sequence mode: warm-start chaining)"
+             if sequence else "")
 
-    for left_path, right_path in zip(left_images, right_images):
-        disp = runner.disparity(read_image(left_path),
-                                read_image(right_path))
+    state = None                # previous frame's padded low-res flow
+    t_seq = time.perf_counter()
+    for idx, (left_path, right_path) in enumerate(zip(left_images,
+                                                      right_images)):
+        left, right = read_image(left_path), read_image(right_path)
+        if sequence:
+            # Frames are a temporally ordered sequence: warm-start the
+            # GRU from the previous frame's disparity (RAFT's warm
+            # start) and chain the state forward.  A resolution change
+            # restarts cold, like a scene cut would on the server.
+            try:
+                frame = runner.run_stream(left, right,
+                                          prev_flow_low=state)
+            except ValueError:          # resolution changed mid-glob
+                frame = runner.run_stream(left, right)
+            # Keyframe guard (the serving engine's session_reseed_on_cap
+            # policy): a warm frame that ran to the cap never satisfied
+            # the convergence gate — drop the state so the next frame
+            # cold-starts instead of chaining a drifting field.
+            state = (None if (frame.warm and frame.iters_used is not None
+                              and frame.iters_used >= args.valid_iters)
+                     else frame.flow_low)
+            disp = frame.disparity
+        else:
+            disp = runner.disparity(left, right)
+            frame = None
         stem = os.path.splitext(os.path.basename(left_path))[0]
         if args.save_numpy:
             np.save(os.path.join(out_dir, f"{stem}.npy"), disp)
         vis = jet_colormap(disp / max(float(disp.max()), 1e-6))
         Image.fromarray(vis).save(os.path.join(out_dir,
                                                f"{stem}-disparity.png"))
-        if runner.last_iters_used is not None:
+        if sequence:
+            fps = (idx + 1) / (time.perf_counter() - t_seq)
+            log.info(
+                "%s: frame %d %s iters_used %s/%d, cumulative %.2f FPS, "
+                "disparity range [%.2f, %.2f]", stem, idx,
+                "warm" if frame.warm else "cold",
+                frame.iters_used if frame.iters_used is not None else "-",
+                args.valid_iters, fps, disp.min(), disp.max())
+        elif runner.last_iters_used is not None:
             log.info("%s: disparity range [%.2f, %.2f] (iters_used %d/%d)",
                      stem, disp.min(), disp.max(), runner.last_iters_used,
                      args.valid_iters)
         else:
             log.info("%s: disparity range [%.2f, %.2f]", stem, disp.min(),
                      disp.max())
-    if runner.iters_used_mean() is not None:
+    if sequence:
+        wall = time.perf_counter() - t_seq
+        log.info("sequence done: %d frames in %.2fs (%.2f FPS)%s",
+                 len(left_images), wall, len(left_images) / wall,
+                 (f", mean iters_used {runner.iters_used_mean():.2f} "
+                  f"of {args.valid_iters}"
+                  if runner.iters_used_mean() is not None else ""))
+    elif runner.iters_used_mean() is not None:
         log.info("adaptive early exit: mean iters_used %.2f of %d "
                  "(threshold %.4g px, min %d)", runner.iters_used_mean(),
                  args.valid_iters, args.exit_threshold_px or 0.0,
@@ -93,6 +137,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-r", "--right_imgs", required=True,
                    help="glob for right (im1) images")
     p.add_argument("--output_directory", default="demo_output")
+    p.add_argument("--sequence", nargs="?", const=True, default=None,
+                   metavar="GLOB",
+                   help="treat the frames as a temporally ORDERED video "
+                        "sequence: each frame warm-starts the GRU from "
+                        "the previous frame's disparity (RAFT's warm "
+                        "start) and logs per-frame iters_used + "
+                        "cumulative FPS.  The optional GLOB overrides "
+                        "--left_imgs.  Combine with --exit_threshold_px "
+                        "so warm frames actually exit earlier")
     p.add_argument("--save_numpy", action="store_true")
     p.add_argument("--valid_iters", type=int, default=32)
     p.add_argument("--exit_threshold_px", type=float, default=None,

@@ -19,9 +19,9 @@ log = logging.getLogger(__name__)
 
 
 def run_eval(args) -> dict:
-    from raft_stereo_tpu.eval import (InferenceRunner, validate_eth3d,
-                                      validate_kitti, validate_middlebury,
-                                      validate_things)
+    from raft_stereo_tpu.eval import (InferenceRunner, sequence_drift,
+                                      validate_eth3d, validate_kitti,
+                                      validate_middlebury, validate_things)
 
     overrides = common.arch_overrides(args)
     # mirror the reference: bf16 lookup is safe only for the fused corr
@@ -34,6 +34,45 @@ def run_eval(args) -> dict:
                              exit_min_iters=args.min_iters)
 
     root = args.data_root
+    if args.sequence:
+        # Sequence mode (round 14 streaming sessions): the dataset's
+        # frames run in order twice — cold per-frame vs warm-start
+        # chained — and the row reports the EPE drift + iters/FPS split
+        # (eval/validate.sequence_drift).  --stream_out records the row
+        # as a versioned bench JSON (bench_stream.py drives this over
+        # the synthetic validators -> STREAM_r14.json).
+        from raft_stereo_tpu.data import datasets as ds
+
+        if args.dataset == "eth3d":
+            dataset = ds.ETH3D(root=f"{root}/ETH3D")
+        elif args.dataset == "kitti":
+            dataset = ds.KITTI(root=f"{root}/KITTI")
+        elif args.dataset == "things":
+            dataset = ds.SceneFlow(root=root, dstype="frames_finalpass",
+                                   things_test=True)
+        elif args.dataset.startswith("middlebury_"):
+            dataset = ds.Middlebury(
+                root=f"{root}/Middlebury",
+                split=args.dataset.removeprefix("middlebury_"))
+        else:
+            raise SystemExit(f"unknown dataset {args.dataset!r}")
+        results = sequence_drift(runner, dataset, args.dataset,
+                                 max_images=args.max_images)
+        if args.stream_out:
+            from raft_stereo_tpu.telemetry.events import (bench_record,
+                                                          write_record)
+            write_record(args.stream_out, bench_record({
+                "metric": "warm_start_sequence_drift",
+                "value": results[f"{args.dataset}-warm-drift-epe"],
+                "unit": "EPE(warm chained) - EPE(cold per-frame), px",
+                "dataset": args.dataset,
+                "valid_iters": args.valid_iters,
+                "exit_threshold_px": args.exit_threshold_px,
+                "min_iters": args.min_iters,
+                "results": {k: round(v, 5) for k, v in results.items()},
+            }), indent=1)
+            log.info("sequence-drift record -> %s", args.stream_out)
+        return results
     if args.dataset == "eth3d":
         results = validate_eth3d(runner, root=f"{root}/ETH3D",
                                  max_images=args.max_images)
@@ -87,6 +126,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "eval/runner.py; fp16 ulp <= 0.125 px at |d|<256)")
     p.add_argument("--max_images", type=int, default=None,
                    help="evaluate only the first N images (smoke runs)")
+    p.add_argument("--sequence", action="store_true",
+                   help="sequence mode: run the dataset's frames in "
+                        "order twice — cold per-frame vs warm-start "
+                        "chained (each frame's GRU seeded from the "
+                        "previous frame's disparity) — and report the "
+                        "warm-start EPE drift plus per-pass iters/FPS")
+    p.add_argument("--stream_out", default=None,
+                   help="with --sequence: write the drift row as a "
+                        "versioned bench JSON (e.g. STREAM_r14.json)")
     p.add_argument("--json", action="store_true",
                    help="print results as one JSON line")
     common.add_arch_overrides(p)

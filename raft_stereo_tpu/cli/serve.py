@@ -77,6 +77,10 @@ def build_service(args):
         brownout=args.brownout,
         brownout_exempt_tiers=exempt,
         executable_cache_dir=args.executable_cache_dir,
+        sessions=args.sessions,
+        session_ttl_s=args.session_ttl_s,
+        session_capacity=args.session_capacity,
+        scene_cut_threshold=args.scene_cut_threshold,
         warmup_shapes=tuple(args.warmup_shape or ()),
         prewarm_on_init=False)
     return StereoService(cfg, variables, serve_cfg)
@@ -160,12 +164,15 @@ def run_serve(args) -> int:
             signal.signal(sig, _graceful)
 
     log.info("serving on %s (batch sizes %s, queue<=%d, %d device "
-             "worker(s), %s buckets, tiers %s)", server.url,
+             "worker(s), %s buckets, tiers %s, sessions %s)", server.url,
              service.queue.sizes, service.serve_cfg.max_queue,
              len(service.devices),
              "adaptive" if service.policy.adaptive else "static",
              (f"{sorted(service.tiers)} default={service.default_tier}"
-              if service.tiers else "off"))
+              if service.tiers else "off"),
+             (f"on (ttl {service.serve_cfg.session_ttl_s:.0f}s, "
+              f"capacity {service.serve_cfg.session_capacity})"
+              if service.sessions is not None else "off"))
     try:
         # serve_forever already runs on the server thread (started above
         # so readiness answered during prewarm); park the main thread on
@@ -319,6 +326,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of tiers brownout must never "
                         "degrade (e.g. 'quality' for contractual full-"
                         "quality clients)")
+    # Streaming sessions (warm-start video serving; serving/sessions.py).
+    p.add_argument("--sessions", action="store_true",
+                   help="enable streaming stereo sessions: POST "
+                        "/v1/stream/<id> frames warm-start the GRU from "
+                        "the session's previous disparity (with an "
+                        "early-exit tier the convergence gate then stalls "
+                        "in a fraction of the cold iterations — the "
+                        "video FPS win bench_stream.py measures); "
+                        "DELETE /v1/stream/<id> closes a session")
+    p.add_argument("--session_ttl_s", type=float, default=30.0,
+                   help="idle seconds before a session expires (its next "
+                        "frame gets the typed 410; the client must open "
+                        "a fresh session)")
+    p.add_argument("--session_capacity", type=int, default=256,
+                   help="live-session ceiling; beyond it the least-"
+                        "recently-used session is evicted (410 on its "
+                        "next frame)")
+    p.add_argument("--scene_cut_threshold", type=float, default=40.0,
+                   help="scene-cut fallback: a frame whose mean "
+                        "|delta-intensity| vs the previous frame exceeds "
+                        "this (0..255) cold-starts instead of warm-"
+                        "starting from a stale disparity; <= 0 disables "
+                        "the check")
     p.add_argument("--chaos", default=None,
                    help="FAULT INJECTION (testing only): comma key=value "
                         "spec, e.g. 'crash=0.1,seed=7' for a 10%% "

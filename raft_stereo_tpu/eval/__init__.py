@@ -1,4 +1,5 @@
-from raft_stereo_tpu.eval.runner import InferenceRunner
-from raft_stereo_tpu.eval.validate import (validate_eth3d, validate_kitti,
+from raft_stereo_tpu.eval.runner import InferenceRunner, StreamFrame
+from raft_stereo_tpu.eval.validate import (sequence_drift, validate_eth3d,
+                                           validate_kitti,
                                            validate_middlebury,
                                            validate_things)

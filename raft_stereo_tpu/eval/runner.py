@@ -70,7 +70,8 @@ def early_exit_enabled(config: RaftStereoConfig) -> bool:
 
 
 def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
-                 donate_images: bool = True):
+                 donate_images: bool = True, warm_start: bool = False,
+                 return_state: bool = False):
     """The one jitted inference program both the solo runner and the
     serving engine compile, per (padded shape, batch): cast -> forward ->
     optional half-precision fetch cast.  Built here so the two paths share
@@ -88,8 +89,48 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     buffers, so the runtime is free to reclaim or alias them the moment
     the program consumes them.  Donation never changes numerics (tested)
     and the module-level filter above silences XLA's not-usable note for
-    output shapes that cannot alias."""
+    output shapes that cannot alias.
+
+    Streaming variants (round 14 warm-start sessions; both default OFF,
+    keeping the base program byte-for-byte the pre-session build):
+
+    * ``return_state=True`` — the program additionally returns the final
+      PADDED low-res x-flow (``flow_low``, (N, Hp/f, Wp/f) float32, f =
+      ``config.downsample_factor``): the temporal state a streaming
+      session feeds the next frame.  Same math, same ``flow_up`` values
+      (pinned bitwise by tests/test_sessions.py) — one extra small
+      output rides the fetch.  Return order: ``(flow_up, flow_low[,
+      iters_used])``.
+    * ``warm_start=True`` (implies ``return_state``) — the program takes
+      a fourth traced argument ``flow_init`` ((N, Hp/f, Wp/f) float32)
+      and seeds the GRU refinement from it instead of zero
+      (models/raft_stereo.py; RAFT's warm start, arXiv 2109.07547 §3).
+      ``flow_init`` is donated alongside the images when
+      ``donate_images`` — it is the same shape/dtype as the
+      ``flow_low`` output, so XLA can alias the state round-trip.
+    """
     adaptive = early_exit_enabled(model.config)
+
+    if warm_start or return_state:
+        def fwd_stream(variables, images1, images2, *flow_init):
+            img1 = images1.astype(jnp.float32)
+            img2 = images2.astype(jnp.float32)
+            out = model.apply(
+                variables, img1, img2, iters=iters, test_mode=True,
+                flow_init=(flow_init[0].astype(jnp.float32)
+                           if warm_start else None))
+            flow_up = out[1]
+            if fetch_dtype is not None:
+                flow_up = flow_up.astype(fetch_dtype)
+            # flow_low stays float32 regardless of fetch_dtype: it is the
+            # next frame's init, and a half-precision state would compound
+            # rounding frame over frame.
+            ret = (flow_up, out[0].astype(jnp.float32))
+            return ret + ((out[2],) if adaptive else ())
+
+        donate = ((1, 2, 3) if warm_start else (1, 2)) \
+            if donate_images else ()
+        return jax.jit(fwd_stream, donate_argnums=donate)
 
     def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
         img1 = images1.astype(jnp.float32)
@@ -102,6 +143,26 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
         return (flow_up, out[2]) if adaptive else flow_up
 
     return jax.jit(fwd, donate_argnums=(1, 2) if donate_images else ())
+
+
+@dataclasses.dataclass
+class StreamFrame:
+    """One frame of a warm-started sequence (``InferenceRunner.run_stream``).
+
+    ``flow`` is the usual unpadded (H, W) x-flow; ``flow_low`` is the
+    PADDED low-res x-flow to feed back as the next frame's
+    ``prev_flow_low`` — padded on purpose: consecutive frames share the
+    padded grid, so the state round-trips without resampling."""
+
+    flow: np.ndarray             # (H, W) float32 x-flow (= -disparity)
+    flow_low: np.ndarray         # (Hp/f, Wp/f) float32 padded low-res state
+    seconds: float               # same stop clock as __call__ (result fetch)
+    iters_used: Optional[int]    # GRU trip count (None without early exit)
+    warm: bool                   # True when prev_flow_low seeded the GRU
+
+    @property
+    def disparity(self) -> np.ndarray:
+        return -self.flow
 
 
 class InferenceRunner:
@@ -200,6 +261,12 @@ class InferenceRunner:
         self.cost_site = cost_site
         self.donate_images = donate_images
         self._compiled: Dict[Tuple[int, int], any] = {}
+        # Streaming (warm-start) programs live in their own small cache:
+        # they carry an extra state output (and, warm, an extra input),
+        # so they are distinct executables from the ``_compiled`` ones —
+        # and keeping them apart leaves the sessionless cache, its cost
+        # keys, and its eviction accounting byte-for-byte untouched.
+        self._stream_compiled: Dict[Tuple, any] = {}
 
     def _cost_key(self, padded_hw: Tuple[int, int], batch: int) -> str:
         """Stable label of one compile point in the cost registry —
@@ -352,6 +419,79 @@ class InferenceRunner:
             flows = flows.astype(np.float32)
         elapsed = time.perf_counter() - t0
         return np.ascontiguousarray(flows), elapsed
+
+    # ------------------------------------------------------------- streaming
+    def _stream_forward_for(self, padded_hw: Tuple[int, int], warm: bool):
+        """The state-returning (and, warm, state-consuming) program for
+        one padded shape — the sequence/demo twin of the serving engine's
+        warm bucket executables.  Bounded like ``_compiled``."""
+        key = (padded_hw, warm)
+        if key not in self._stream_compiled:
+            while len(self._stream_compiled) >= self.max_cached_shapes:
+                self._stream_compiled.pop(
+                    next(iter(self._stream_compiled)))
+            self._stream_compiled[key] = make_forward(
+                self.model, self.iters, self.fetch_dtype,
+                donate_images=self.donate_images,
+                warm_start=warm, return_state=True)
+        else:  # LRU refresh
+            self._stream_compiled[key] = self._stream_compiled.pop(key)
+        return self._stream_compiled[key]
+
+    def run_stream(self, image1: np.ndarray, image2: np.ndarray,
+                   prev_flow_low: Optional[np.ndarray] = None
+                   ) -> StreamFrame:
+        """One frame of a temporally ordered sequence: like ``__call__``
+        but the GRU warm-starts from ``prev_flow_low`` (the previous
+        frame's ``StreamFrame.flow_low``) and the returned frame carries
+        the state to chain forward.  ``prev_flow_low=None`` (frame 0, or
+        after a scene cut) runs the cold zero-init — the same math as the
+        sessionless path (pinned bitwise by tests/test_sessions.py).
+
+        With early exit configured (``exit_threshold_px``) a warm frame
+        typically stalls after far fewer iterations than a cold one —
+        the FPS win bench_stream.py measures.  A ``prev_flow_low`` whose
+        shape does not match this frame's padded low-res grid raises:
+        resolution changes are a caller-visible stream break, not
+        something to resample over silently."""
+        assert image1.ndim == 3 and image1.shape == image2.shape
+        t0 = time.perf_counter()
+        padder = InputPadder((1,) + image1.shape, divis_by=self.divis_by)
+        l, r, t, b = padder.pads
+        spec = ((t, b), (l, r), (0, 0))
+        p1 = np.pad(np.asarray(image1), spec, mode="edge")
+        p2 = np.pad(np.asarray(image2), spec, mode="edge")
+        f = self.effective_config.downsample_factor
+        low_hw = (p1.shape[0] // f, p1.shape[1] // f)
+        warm = prev_flow_low is not None
+        if warm and tuple(prev_flow_low.shape) != low_hw:
+            raise ValueError(
+                f"prev_flow_low shape {prev_flow_low.shape} does not "
+                f"match this frame's padded low-res grid {low_hw} — the "
+                f"stream changed resolution; restart with "
+                f"prev_flow_low=None")
+        fwd = self._stream_forward_for(p1.shape[:2], warm)
+        args = [self.variables, jnp.asarray(p1[None]), jnp.asarray(p2[None])]
+        if warm:
+            args.append(jnp.asarray(
+                np.ascontiguousarray(prev_flow_low, dtype=np.float32)[None]))
+        out = fwd(*args)
+        iters_used = None
+        if self.early_exit:
+            flow_up, flow_low, iters_dev = out
+            iters_used = self._note_iters_used(iters_dev)
+        else:
+            flow_up, flow_low = out
+        flow_padded = np.asarray(flow_up)[0]
+        state = np.ascontiguousarray(np.asarray(flow_low)[0],
+                                     dtype=np.float32)
+        flow = padder.unpad(flow_padded[None])[0]
+        if flow.dtype != np.float32:               # half-precision fetch
+            flow = flow.astype(np.float32)
+        return StreamFrame(flow=np.ascontiguousarray(flow),
+                           flow_low=state,
+                           seconds=time.perf_counter() - t0,
+                           iters_used=iters_used, warm=warm)
 
     def disparity(self, image1: np.ndarray, image2: np.ndarray) -> np.ndarray:
         """Positive disparity map (the demo/user-facing convention,

@@ -135,6 +135,70 @@ def make_validation_fn(model_cfg, train_cfg, data_root: str = "datasets",
     return validate_fn
 
 
+def sequence_drift(runner: InferenceRunner, dataset, name: str,
+                   max_images: Optional[int] = None) -> Dict[str, float]:
+    """Warm-start drift harness (round 14 streaming sessions): run the
+    dataset's frames IN ORDER twice — cold (every frame zero-init, the
+    reference per-frame protocol) and warm (each frame's GRU seeded from
+    the previous frame's low-res disparity, ``InferenceRunner.run_stream``)
+    — and report the EPE cost of chaining: ``<name>-warm-drift-epe`` =
+    warm EPE − cold EPE on the ``valid >= 0.5`` mask.
+
+    On a real video sequence the drift should be ~0 (the warm init is
+    already close to the answer); on shuffled/unrelated frames it measures how
+    robustly the GRU escapes a WRONG init — the bound the streaming
+    scene-cut fallback exists to protect.  With early exit configured the
+    per-pass mean ``iters_used`` and FPS quantify the warm win."""
+    n = len(dataset) if max_images is None else min(len(dataset),
+                                                   max_images)
+
+    def _epe(flow_pr, flow_gt, valid_gt) -> float:
+        err = np.abs(flow_pr - flow_gt).ravel()
+        # Known-GT pixels only: Middlebury marks unknown GT with ±inf
+        # (its validator masks `flow > -1000` on top of the nocc mask —
+        # eval/validate.validate_middlebury), and its valid array
+        # encodes occlusion rather than GT validity, so fall back to
+        # the known-GT mask when the 0.5 cut selects nothing.
+        gt = flow_gt.ravel()
+        known = np.isfinite(gt) & (gt > -1000)
+        mask = (valid_gt.ravel() >= 0.5) & known
+        if not mask.any():
+            mask = known
+        return float(err[mask].mean())
+
+    out: Dict[str, float] = {}
+    for mode in ("cold", "warm"):
+        runner.reset_iters_used()
+        state = None
+        epes, secs, iters = [], [], []
+        for i in range(n):
+            sample = dataset[i]
+            frame = runner.run_stream(
+                sample["image1"], sample["image2"],
+                prev_flow_low=state if mode == "warm" else None)
+            if mode == "warm":
+                state = frame.flow_low
+            # Frame 0 pays the cold compile; the warm pass's frame 1
+            # additionally pays the warm-program compile — drop both
+            # from the FPS clock.
+            if i > (1 if mode == "warm" else 0):
+                secs.append(frame.seconds)
+            if frame.iters_used is not None:
+                iters.append(frame.iters_used)
+            epes.append(_epe(frame.flow, sample["flow"], sample["valid"]))
+        out[f"{name}-epe-{mode}"] = float(np.mean(epes))
+        if secs:
+            out[f"{name}-fps-{mode}"] = float(1.0 / np.mean(secs))
+        if iters:
+            out[f"{name}-iters-{mode}-mean"] = float(np.mean(iters))
+    out[f"{name}-warm-drift-epe"] = (out[f"{name}-epe-warm"]
+                                     - out[f"{name}-epe-cold"])
+    print(f"Sequence {name}: cold EPE {out[f'{name}-epe-cold']:.4f}, "
+          f"warm EPE {out[f'{name}-epe-warm']:.4f}, drift "
+          f"{out[f'{name}-warm-drift-epe']:+.4f}")
+    return out
+
+
 def validate_eth3d(runner: InferenceRunner, root: str = "datasets/ETH3D",
                    max_images: Optional[int] = None) -> Dict[str, float]:
     """ETH3D two-view training split (reference: evaluate_stereo.py:19-57)."""

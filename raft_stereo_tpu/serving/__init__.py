@@ -2,8 +2,10 @@
 batch executables, continuous batching, admission control + backpressure,
 waste-driven bucket selection, supervised crash recovery (retries,
 per-device circuit breakers, brownout degradation, chaos testing, a
-persistent executable cache), and a plain-text metrics endpoint.  See
-docs/architecture.md §Serving and §Resilience."""
+persistent executable cache), streaming stereo sessions (warm-start video
+serving with temporal state, serving/sessions.py), and a plain-text
+metrics endpoint.  See docs/architecture.md §Serving, §Resilience, and
+§Streaming sessions."""
 
 from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
                                              Overloaded, Request,
@@ -16,9 +18,10 @@ from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
                                            InjectedResourceExhausted,
                                            InjectedWorkerCrash,
                                            parse_chaos_spec)
-from raft_stereo_tpu.serving.engine import (BucketPolicy, ServeConfig,
-                                            ServeResult, ServingEngine,
-                                            StereoService)
+from raft_stereo_tpu.serving.engine import (FAMILY_BASE, FAMILY_STATE,
+                                            FAMILY_WARM, BucketPolicy,
+                                            ServeConfig, ServeResult,
+                                            ServingEngine, StereoService)
 from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
 from raft_stereo_tpu.serving.persist import (ExecutableDiskCache,
                                              enable_persistent_compilation_cache,
@@ -30,6 +33,10 @@ from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
                                                 CircuitBreaker,
                                                 circuit_state_name,
                                                 cost_ladder)
+from raft_stereo_tpu.serving.sessions import (SessionExpired,
+                                              SessionsDisabled,
+                                              SessionStore, StereoSession,
+                                              frame_delta, frame_thumbnail)
 
 __all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
            "RequestPoisoned", "decompose_batch", "pick_batch_size",
@@ -41,4 +48,6 @@ __all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
            "enable_persistent_compilation_cache", "executable_cache_key",
            "CIRCUIT_CLOSED", "CIRCUIT_HALF_OPEN", "CIRCUIT_OPEN",
            "BrownoutController", "CircuitBreaker", "circuit_state_name",
-           "cost_ladder"]
+           "cost_ladder", "FAMILY_BASE", "FAMILY_STATE", "FAMILY_WARM",
+           "SessionExpired", "SessionsDisabled", "SessionStore",
+           "StereoSession", "frame_delta", "frame_thumbnail"]

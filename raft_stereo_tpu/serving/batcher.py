@@ -92,14 +92,26 @@ class Request:
     # (``requested_tier is None`` means no degradation happened).
     attempts: int = 0
     requested_tier: Optional[str] = None
+    # Executable family (serving/engine.py streaming sessions): None =
+    # the base sessionless program; "state" = session cold frames (the
+    # program additionally returns the low-res state); "warm" = session
+    # warm frames (the program also CONSUMES a flow_init input).  Part
+    # of the compatibility key below — the three families are distinct
+    # compiled programs and must never share a dispatch batch.  Frames
+    # of ONE session never coexist in the queue at all (the engine holds
+    # the session's ordering lock from submit to resolution), so a
+    # dispatch cycle cannot reorder a session's frames.
+    family: Optional[str] = None
+    session_id: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
 
     @property
     def group_key(self) -> Tuple:
-        """What batches together: same padded bucket AND same tier."""
-        return (self.bucket, self.tier)
+        """What batches together: same padded bucket, same tier, same
+        executable family (base / session-state / warm)."""
+        return (self.bucket, self.tier, self.family)
 
 
 def pick_batch_size(depth: int, sizes: Sequence[int]) -> int:

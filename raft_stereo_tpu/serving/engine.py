@@ -94,12 +94,22 @@ from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
                                                 CircuitBreaker,
                                                 circuit_state_name,
                                                 cost_ladder)
+from raft_stereo_tpu.serving.sessions import (SessionsDisabled, SessionStore,
+                                              frame_delta, frame_thumbnail)
 
 log = logging.getLogger(__name__)
 
 # The model's divisibility constraint: every pad grid must be a multiple
 # of this, and the adaptive policy can never refine below it.
 MODEL_DIVIS = 32
+
+# Executable families a (bucket, batch, tier) compiles under
+# (eval/runner.make_forward): the base sessionless program, the
+# state-returning program session cold frames run (same math, one extra
+# low-res output), and the warm program that also consumes a flow_init.
+FAMILY_BASE = None
+FAMILY_STATE = "state"
+FAMILY_WARM = "warm"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,9 +215,39 @@ class ServeConfig:
     brownout_exempt_tiers: Tuple[str, ...] = ()
     # Persistent AOT executable cache directory (serving/persist.py):
     # compiled bucket executables serialize here keyed by (config, shape,
-    # batch, tier, backend fingerprint) so a restarted process prewarm
+    # batch, tier, executable family — warm programs have a different
+    # arity — and backend fingerprint) so a restarted process prewarm
     # loads from disk instead of recompiling.  None (default) = off.
     executable_cache_dir: Optional[str] = None
+    # ---- Streaming sessions (round 14; serving/sessions.py) ------------
+    # Stateful video serving: POST /v1/stream/<id> frames warm-start the
+    # GRU from the session's previous low-res disparity, so with an
+    # early-exit tier the convergence gate stalls after a fraction of the
+    # cold iterations.  False (default): no session store, no warm
+    # executable families — the engine is exactly the stateless round-13
+    # build (bitwise-pinned by tests/test_sessions.py).
+    sessions: bool = False
+    # Idle seconds before a session's state expires (typed 410 on the
+    # next frame; the client must open a fresh session).
+    session_ttl_s: float = 30.0
+    # Live-session ceiling; beyond it the least-recently-used session is
+    # evicted (410 on its next frame).
+    session_capacity: int = 256
+    # Scene-cut fallback: a frame whose mean |Δintensity| vs the previous
+    # frame's thumbnail exceeds this (0..255 units) cold-starts instead
+    # of warm-starting from a disparity field the cut invalidated.
+    # <= 0 disables the check (every in-session frame warm-starts).
+    scene_cut_threshold: float = 40.0
+    # Keyframe guard: a WARM frame on an early-exit tier that runs to the
+    # iteration cap never satisfied the convergence gate — its output may
+    # be drifting (warm-start chains accumulate error when the GRU is
+    # not contracting; measured in STREAM_r14.json), so its state is not
+    # trusted and the NEXT frame cold-starts, re-seeding the chain from
+    # a clean zero-init (the video-codec I-frame move).  Cold frames at
+    # the cap stay trusted: that is the stateless baseline by
+    # definition.  No effect on fixed-depth tiers (every frame runs the
+    # cap there by construction).
+    session_reseed_on_cap: bool = True
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -276,6 +316,13 @@ class ServeConfig:
                 raise ValueError(
                     f"brownout_exempt_tiers={self.brownout_exempt_tiers}: "
                     f"{t!r} is not one of the configured tiers {names}")
+        if self.sessions:
+            if self.session_ttl_s <= 0:
+                raise ValueError(f"session_ttl_s={self.session_ttl_s} "
+                                 f"must be > 0")
+            if self.session_capacity < 1:
+                raise ValueError(f"session_capacity="
+                                 f"{self.session_capacity} must be >= 1")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -302,6 +349,18 @@ class ServeResult:
     requested_tier: Optional[str] = None
     attempts: int = 1            # dispatch attempts including the one
     #                              that succeeded (> 1 = recovered crash)
+    # Streaming-session provenance (engine.submit_session): the session
+    # this frame belonged to, its index in the stream, whether the GRU
+    # warm-started from the previous frame's disparity, whether the
+    # scene-cut gate forced a cold start, and the measured inter-frame
+    # delta.  ``flow_low`` is the PADDED low-res x-flow the session
+    # carries forward — surfaced so benches/tests can chain manually.
+    session_id: Optional[str] = None
+    frame_index: Optional[int] = None
+    warm: bool = False
+    scene_cut: bool = False
+    frame_delta: Optional[float] = None
+    flow_low: Optional[np.ndarray] = None
 
     @property
     def degraded(self) -> bool:
@@ -315,11 +374,20 @@ class ServeResult:
 
 @dataclasses.dataclass
 class _Payload:
-    """What the engine parks in Request.payload: padded inputs + unpadder."""
+    """What the engine parks in Request.payload: padded inputs + unpadder,
+    plus (session frames only) the warm-start init and the state the
+    completion callback folds back into the session."""
 
     left: np.ndarray             # (Hp, Wp, 3) host-padded
     right: np.ndarray
     padder: InputPadder
+    flow_init: Optional[np.ndarray] = None   # (Hp/f, Wp/f) f32, warm only
+    session: Optional[object] = None         # sessions.StereoSession
+    thumb: Optional[np.ndarray] = None       # THIS frame's thumbnail
+    raw_shape: Optional[Tuple[int, int]] = None
+    frame_index: Optional[int] = None
+    scene_cut: bool = False
+    frame_delta: Optional[float] = None
 
 
 class BucketPolicy:
@@ -570,6 +638,19 @@ class ServingEngine:
             from raft_stereo_tpu.serving.persist import ExecutableDiskCache
             self.disk_cache = ExecutableDiskCache(
                 serve_cfg.executable_cache_dir)
+        # Streaming-session store (serving/sessions.py): the per-stream
+        # warm-start state behind submit_session / POST /v1/stream.  None
+        # (default) keeps the engine stateless — no warm executable
+        # families compile, prewarm, or join the readiness target.
+        self.sessions: Optional[SessionStore] = None
+        if serve_cfg.sessions:
+            self.sessions = SessionStore(
+                capacity=serve_cfg.session_capacity,
+                ttl_s=serve_cfg.session_ttl_s,
+                active_gauge=self.metrics.sessions_active,
+                created_counter=self.metrics.sessions_created,
+                expired_counter=self.metrics.sessions_expired,
+                evicted_counter=self.metrics.sessions_evicted)
         # Retry bookkeeping: requests bounced by a crashed dispatch sit in
         # backoff timers between dequeue and requeue — drain() must wait
         # for them and close() must fail them, so they are accounted here.
@@ -588,7 +669,9 @@ class ServingEngine:
             for widx in range(len(self.devices)):
                 for tier in self._distinct_cache_tiers():
                     for n in self.queue.sizes:
-                        self._warm_target.add((widx, (hp, wp), n, tier))
+                        for family in self._families():
+                            self._warm_target.add(
+                                (widx, (hp, wp), n, tier, family))
         self._closed = False
         self._workers_lock = threading.Lock()
         self._workers = [
@@ -670,6 +753,19 @@ class ServingEngine:
         ``requested_tier`` / ``degraded``.
         """
         t_admit = time.perf_counter()
+        tier, requested_tier = self._admit_tier(tier, degradable)
+        left, right = np.asarray(left), np.asarray(right)
+        if left.ndim != 3 or left.shape != right.shape:
+            raise ValueError(
+                f"need two same-shape (H, W, 3) images, got {left.shape} "
+                f"vs {right.shape}")
+        return self._enqueue(left, right, deadline_ms, tier,
+                             requested_tier, t_admit).future
+
+    def _admit_tier(self, tier: Optional[str], degradable: bool
+                    ) -> Tuple[Optional[str], Optional[str]]:
+        """Resolve the requested tier and apply brownout degradation:
+        ``(effective_tier, requested_tier_if_degraded)``."""
         tier = self.resolve_tier(tier)
         requested_tier = None
         if (self.brownout is not None and degradable
@@ -677,24 +773,39 @@ class ServingEngine:
             effective = self.brownout.degrade(tier)
             if effective != tier:
                 requested_tier, tier = tier, effective
-        left, right = np.asarray(left), np.asarray(right)
-        if left.ndim != 3 or left.shape != right.shape:
-            raise ValueError(
-                f"need two same-shape (H, W, 3) images, got {left.shape} "
-                f"vs {right.shape}")
+        return tier, requested_tier
+
+    def _enqueue(self, left: np.ndarray, right: np.ndarray,
+                 deadline_ms: Optional[float], tier: Optional[str],
+                 requested_tier: Optional[str], t_admit: float,
+                 family: Optional[str] = FAMILY_BASE,
+                 session=None, session_id: Optional[str] = None,
+                 flow_init: Optional[np.ndarray] = None,
+                 thumb: Optional[np.ndarray] = None,
+                 frame_index: Optional[int] = None,
+                 scene_cut: bool = False,
+                 frame_delta_v: Optional[float] = None) -> Request:
+        """Pad, build, trace, and queue one request — shared by the
+        stateless ``submit`` (base family, no session fields) and the
+        streaming ``submit_session``."""
         hp, wp, grid = self.policy.bucket_for(left.shape[0], left.shape[1])
         padder = InputPadder((1,) + left.shape, divis_by=grid)
         l, r, t, b = padder.pads
         spec = ((t, b), (l, r), (0, 0))
         payload = _Payload(left=np.pad(left, spec, mode="edge"),
                            right=np.pad(right, spec, mode="edge"),
-                           padder=padder)
+                           padder=padder, flow_init=flow_init,
+                           session=session, thumb=thumb,
+                           raw_shape=tuple(left.shape[:2]),
+                           frame_index=frame_index, scene_cut=scene_cut,
+                           frame_delta=frame_delta_v)
         now = time.monotonic()
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.serve_cfg.default_deadline_ms)
         req = Request(bucket=(hp, wp), payload=payload,
                       future=Future(), t_enqueue=now, tier=tier,
                       requested_tier=requested_tier,
+                      family=family, session_id=session_id,
                       deadline=(None if deadline_ms is None
                                 else now + deadline_ms / 1e3))
         # Sampled request: root span + admission (validate/pad) span; the
@@ -703,7 +814,8 @@ class ServingEngine:
         trace = self.tracer.start_trace(
             "serve.request", bucket=str(req.bucket),
             deadline_ms=deadline_ms,
-            **({"tier": tier} if tier is not None else {}))
+            **({"tier": tier} if tier is not None else {}),
+            **({"session": session_id} if session_id is not None else {}))
         if trace is not None:
             req.trace = trace
             self.tracer.add_span("serve.admission", trace,
@@ -723,7 +835,7 @@ class ServingEngine:
             self.metrics.degraded.inc()
             if trace is not None and trace.root is not None:
                 trace.root.set_attr("degraded_from", requested_tier)
-        return req.future
+        return req
 
     def _finish_request_trace(self, req: Request, future) -> None:
         """Close the queue span (if no worker picked the request up) and
@@ -748,6 +860,142 @@ class ServingEngine:
         """Blocking convenience: submit + wait (the in-process client)."""
         return self.submit(left, right, deadline_ms, tier=tier,
                            degradable=degradable).result(timeout=timeout)
+
+    # ---------------------------------------------------- streaming sessions
+    def submit_session(self, session_id: str, left: np.ndarray,
+                       right: np.ndarray,
+                       deadline_ms: Optional[float] = None,
+                       tier: Optional[str] = None,
+                       degradable: bool = True) -> Future:
+        """Admit one frame of a streaming session (the engine behind
+        ``POST /v1/stream/<session>``).  Returns a Future of
+        ``ServeResult`` whose session fields say what happened:
+        ``warm`` (the GRU was seeded from the previous frame's
+        disparity), ``scene_cut`` (the inter-frame delta check failed and
+        the frame cold-started), ``frame_index``, ``frame_delta``.
+
+        First frame of a new id creates the session and cold-starts;
+        every subsequent frame warm-starts unless the resolution changed,
+        the previous frame failed, or the scene-cut gate fired.  Raises
+        the typed ``SessionExpired`` (HTTP 410) on a TTL-expired /
+        LRU-evicted / closed id and ``SessionsDisabled`` when the engine
+        has no session store.
+
+        **Ordering:** the session's ordering lock is held from here until
+        the frame's future resolves, so a session never has two frames
+        in flight and a dispatch cycle can never reorder its frames —
+        the call blocks while the previous frame of the SAME session is
+        still pending (distinct sessions proceed concurrently and batch
+        together freely).  Every admitted frame terminates (success or
+        typed error; round-13 guarantee), so the lock cannot be held
+        forever."""
+        if self.sessions is None:
+            raise SessionsDisabled(
+                "this engine runs without a session store — construct it "
+                "with ServeConfig(sessions=True) to stream")
+        t_admit = time.perf_counter()
+        tier, requested_tier = self._admit_tier(tier, degradable)
+        left, right = np.asarray(left), np.asarray(right)
+        if left.ndim != 3 or left.shape != right.shape:
+            raise ValueError(
+                f"need two same-shape (H, W, 3) images, got {left.shape} "
+                f"vs {right.shape}")
+        sess, created = self.sessions.get_or_create(session_id)
+        # One frame per session in the pipeline: block until the previous
+        # frame's future resolved (its done-callback releases the lock).
+        sess.order_lock.acquire()
+        try:
+            thumb = frame_thumbnail(left)
+            hp, wp, _grid = self.policy.bucket_for(left.shape[0],
+                                                   left.shape[1])
+            warm = (not created and sess.flow_low is not None
+                    and sess.bucket == (hp, wp)
+                    and sess.raw_shape == tuple(left.shape[:2]))
+            scene_cut = False
+            delta = None
+            if warm:
+                delta = frame_delta(thumb, sess.thumb)
+                if delta is not None:
+                    self.metrics.frame_delta.observe(delta)
+                    if (self.serve_cfg.scene_cut_threshold > 0
+                            and delta > self.serve_cfg.scene_cut_threshold):
+                        # The previous disparity field belongs to a scene
+                        # this frame is not in: a warm start would anchor
+                        # the GRU to garbage, so fall back to cold (the
+                        # session survives — state re-seeds from this
+                        # frame's result).
+                        warm, scene_cut = False, True
+                        sess.scene_cuts += 1
+                        self.metrics.scene_cuts.inc()
+            req = self._enqueue(
+                left, right, deadline_ms, tier, requested_tier, t_admit,
+                family=FAMILY_WARM if warm else FAMILY_STATE,
+                session=sess, session_id=session_id,
+                flow_init=sess.flow_low if warm else None,
+                thumb=thumb, frame_index=sess.frame_index,
+                scene_cut=scene_cut, frame_delta_v=delta)
+        except BaseException:
+            sess.order_lock.release()
+            raise
+        req.future.add_done_callback(
+            lambda f, r=req: self._finish_session_frame(r, f))
+        return req.future
+
+    def infer_session(self, session_id: str, left: np.ndarray,
+                      right: np.ndarray,
+                      deadline_ms: Optional[float] = None,
+                      timeout: Optional[float] = None,
+                      tier: Optional[str] = None,
+                      degradable: bool = True) -> ServeResult:
+        """Blocking convenience: submit_session + wait."""
+        return self.submit_session(
+            session_id, left, right, deadline_ms, tier=tier,
+            degradable=degradable).result(timeout=timeout)
+
+    def close_session(self, session_id: str) -> Dict[str, object]:
+        """End one session deliberately (``DELETE /v1/stream/<id>``);
+        returns its lifetime stats.  Raises ``SessionsDisabled`` /
+        ``SessionExpired`` / ``KeyError`` like the store."""
+        if self.sessions is None:
+            raise SessionsDisabled("this engine runs without a session "
+                                   "store")
+        return self.sessions.close(session_id)
+
+    def _finish_session_frame(self, req: Request, future) -> None:
+        """Completion hook of one session frame: fold the result's state
+        back into the session (under the ordering lock, so the next
+        frame — possibly already blocked in ``submit_session`` — reads a
+        consistent snapshot), then release the lock.  A failed frame
+        releases without touching state: the session's previous state
+        stays the warm-start source, and the scene-cut delta check
+        guards against it having gone stale."""
+        sess = req.payload.session
+        try:
+            if future.exception() is None:
+                res = future.result()
+                flow_low = res.flow_low
+                if (self.serve_cfg.session_reseed_on_cap and res.warm
+                        and res.iters_used is not None
+                        and res.iters_used >= self.serve_cfg.iters
+                        and early_exit_enabled(self._tier_models[
+                            self._cache_tier(req.tier)].config)):
+                    # Keyframe guard (ServeConfig.session_reseed_on_cap):
+                    # the gate never fired, so this warm output is not a
+                    # trusted init — drop the state and let the next
+                    # frame cold-start.
+                    flow_low = None
+                    self.metrics.session_reseeds.inc()
+                sess.note_result(
+                    flow_low=flow_low, thumb=req.payload.thumb,
+                    bucket=req.bucket, raw_shape=req.payload.raw_shape,
+                    warm=res.warm, iters_used=res.iters_used)
+                self.metrics.observe_session_frame(
+                    "warm" if res.warm else "cold")
+        finally:
+            # The dispatch counts as session activity: a first-frame
+            # compile longer than the TTL must not expire the stream.
+            self.sessions.touch(req.session_id)
+            sess.order_lock.release()
 
     # ------------------------------------------------------------ readiness
     @property
@@ -777,9 +1025,20 @@ class ServingEngine:
         return out
 
     def _note_warm(self, widx: int, bucket: Tuple[int, int], batch: int,
-                   cache_tier: Optional[str]) -> None:
+                   cache_tier: Optional[str],
+                   family: Optional[str] = FAMILY_BASE) -> None:
         with self._warm_lock:
-            self._warmed.add((widx, tuple(bucket), batch, cache_tier))
+            self._warmed.add((widx, tuple(bucket), batch, cache_tier,
+                              family))
+
+    def _families(self) -> Tuple[Optional[str], ...]:
+        """The executable families this engine serves: the base program
+        always; the session state/warm variants only when the session
+        store exists (so a stateless engine's compile surface, prewarm
+        cost, and readiness target are exactly the round-13 ones)."""
+        if self.sessions is None:
+            return (FAMILY_BASE,)
+        return (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)
 
     # --------------------------------------------------------- compile cache
     def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
@@ -799,29 +1058,34 @@ class ServingEngine:
                       key=lambda t: (t is not None, t or ""))
 
     def _cost_key(self, bucket: Tuple[int, int], batch: int,
-                  tier: Optional[str] = None) -> str:
+                  tier: Optional[str] = None,
+                  family: Optional[str] = FAMILY_BASE) -> str:
         """Stable label of one compile point in the cost registry — what
         GET /debug/compiles lists and the MFU path looks up."""
         tail = "" if self._cache_tier(tier) is None else f",tier={tier}"
+        if family is not None:
+            tail += f",{family}"
         return f"serving.forward({bucket[0]}x{bucket[1]},b{batch}{tail})"
 
     def compiled_cost(self, bucket: Tuple[int, int], batch: int = 1,
-                      tier: Optional[str] = None):
+                      tier: Optional[str] = None,
+                      family: Optional[str] = FAMILY_BASE):
         """The cost record for a compiled (bucket, batch) executable, or
         None (no registry / not compiled yet / analysis degraded)."""
         if self.costs is None:
             return None
-        return self.costs.get(self._cost_key(bucket, batch, tier))
+        return self.costs.get(self._cost_key(bucket, batch, tier, family))
 
     def _forward_for(self, bucket: Tuple[int, int], batch: int = 1,
-                     worker: int = 0, tier: Optional[str] = None):
+                     worker: int = 0, tier: Optional[str] = None,
+                     family: Optional[str] = FAMILY_BASE):
         """The compiled batch-``batch`` executable for ``bucket`` on
         ``worker``'s device — the engine-owned cache the round-6 design
         spread across per-worker InferenceRunners.  Bounded per worker at
-        ``max_cached_shapes`` (bucket, batch, tier) entries, oldest
-        evicted."""
+        ``max_cached_shapes`` (bucket, batch, tier, family) entries,
+        oldest evicted."""
         tier = self._cache_tier(tier)
-        key = (worker, tuple(bucket), batch, tier)
+        key = (worker, tuple(bucket), batch, tier, family)
         with self._cache_lock:
             if key in self._compiled:
                 self._compiled[key] = self._compiled.pop(key)  # LRU refresh
@@ -830,9 +1094,12 @@ class ServingEngine:
         # distinct keys may compile concurrently on different workers.
         fwd = make_forward(self._tier_models[tier], self.serve_cfg.iters,
                            self._fetch_jax_dtype(),
-                           donate_images=self.serve_cfg.donate_buffers)
+                           donate_images=self.serve_cfg.donate_buffers,
+                           warm_start=(family == FAMILY_WARM),
+                           return_state=(family is not FAMILY_BASE))
         if self.disk_cache is not None:
-            fwd = self._load_or_compile(fwd, bucket, batch, worker, tier)
+            fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
+                                        family)
         else:
             # No persistent cache: the executable is built by XLA (at
             # first dispatch on the plain-jit path, inside instrument on
@@ -840,7 +1107,7 @@ class ServingEngine:
             self.metrics.compiles_cold.inc()
             if self.costs is not None:
                 fwd = self.costs.instrument(
-                    fwd, key=self._cost_key(bucket, batch, tier),
+                    fwd, key=self._cost_key(bucket, batch, tier, family),
                     site="serving")
         with self._cache_lock:
             mine = [k for k in self._compiled if k[0] == worker]
@@ -850,25 +1117,29 @@ class ServingEngine:
                 log.info(
                     "engine compile cache full (max_cached_shapes=%d): "
                     "evicting oldest executable for bucket %s batch %d "
-                    "tier %s on worker %d — its next use re-pays XLA "
-                    "compile time",
+                    "tier %s family %s on worker %d — its next use "
+                    "re-pays XLA compile time",
                     self.serve_cfg.max_cached_shapes, evicted[1],
-                    evicted[2], evicted[3], evicted[0])
+                    evicted[2], evicted[3], evicted[4], evicted[0])
                 if self.costs is not None:
                     self.costs.note_runner_eviction(
-                        self._cost_key(evicted[1], evicted[2], evicted[3]),
-                        len(mine))
+                        self._cost_key(*evicted[1:]), len(mine))
             self._compiled[key] = fwd
             if self.costs is not None:
                 self.costs.note_runner_cache_size(len(self._compiled))
         return fwd
 
     def _disk_key(self, bucket: Tuple[int, int], batch: int,
-                  worker: int, cache_tier: Optional[str]) -> str:
+                  worker: int, cache_tier: Optional[str],
+                  family: Optional[str] = FAMILY_BASE) -> str:
         """The persistent-cache content key of one compile point: every
         coordinate that selects a distinct program, plus the device the
         serialized executable is bound to (persist.py mixes in the
-        jax/backend fingerprint)."""
+        jax/backend fingerprint).  ``family`` / ``flow_init`` encode the
+        streaming-program arity — a warm executable takes an extra
+        traced input and returns the low-res state, so it must NEVER
+        share a disk entry with the sessionless program of the same
+        (config, bucket, batch, tier)."""
         from raft_stereo_tpu.serving.persist import executable_cache_key
 
         return executable_cache_key(
@@ -877,10 +1148,12 @@ class ServingEngine:
             tier=cache_tier, iters=self.serve_cfg.iters,
             fetch_dtype=self.serve_cfg.fetch_dtype,
             donate=self.serve_cfg.donate_buffers,
+            family=family, flow_init=(family == FAMILY_WARM),
             device=str(getattr(self.devices[worker], "id", worker)))
 
     def _load_or_compile(self, fwd, bucket: Tuple[int, int], batch: int,
-                         worker: int, cache_tier: Optional[str]):
+                         worker: int, cache_tier: Optional[str],
+                         family: Optional[str] = FAMILY_BASE):
         """The persistent-cache build path: deserialize the executable
         from disk (warm — no XLA compile paid) or AOT-compile it now and
         store it for the next boot (cold).  Either way the cost registry
@@ -890,24 +1163,30 @@ class ServingEngine:
         the dispatch path down."""
         import jax
 
-        disk_key = self._disk_key(bucket, batch, worker, cache_tier)
+        disk_key = self._disk_key(bucket, batch, worker, cache_tier, family)
         t0 = time.perf_counter()
         exe = self.disk_cache.load(disk_key)
         if exe is not None:
             self.metrics.compiles_warm.inc()
-            log.info("bucket %s batch %d tier %s worker %d: executable "
-                     "restored from persistent cache in %.3fs", bucket,
-                     batch, cache_tier, worker, time.perf_counter() - t0)
+            log.info("bucket %s batch %d tier %s family %s worker %d: "
+                     "executable restored from persistent cache in %.3fs",
+                     bucket, batch, cache_tier, family, worker,
+                     time.perf_counter() - t0)
             if self.costs is not None:
                 self.costs.record(
-                    self._cost_key(bucket, batch, cache_tier), "serving",
-                    time.perf_counter() - t0, compiled=exe)
+                    self._cost_key(bucket, batch, cache_tier, family),
+                    "serving", time.perf_counter() - t0, compiled=exe)
             return exe
         aval = jax.ShapeDtypeStruct((batch, bucket[0], bucket[1], 3),
                                     np.uint8)
+        avals = [aval, aval]
+        if family == FAMILY_WARM:
+            f = self._tier_models[cache_tier].config.downsample_factor
+            avals.append(jax.ShapeDtypeStruct(
+                (batch, bucket[0] // f, bucket[1] // f), np.float32))
         try:
-            compiled = fwd.lower(self._worker_vars[worker], aval,
-                                 aval).compile()
+            compiled = fwd.lower(self._worker_vars[worker],
+                                 *avals).compile()
         except Exception:
             log.warning("AOT compile for the persistent cache failed; "
                         "falling back to plain jit dispatch (this "
@@ -915,14 +1194,16 @@ class ServingEngine:
             self.metrics.compiles_cold.inc()
             if self.costs is not None:
                 return self.costs.instrument(
-                    fwd, key=self._cost_key(bucket, batch, cache_tier),
+                    fwd, key=self._cost_key(bucket, batch, cache_tier,
+                                            family),
                     site="serving")
             return fwd
         compile_s = time.perf_counter() - t0
         self.metrics.compiles_cold.inc()
         if self.costs is not None:
-            self.costs.record(self._cost_key(bucket, batch, cache_tier),
-                              "serving", compile_s, compiled=compiled)
+            self.costs.record(
+                self._cost_key(bucket, batch, cache_tier, family),
+                "serving", compile_s, compiled=compiled)
         self.disk_cache.store(disk_key, compiled)
         return compiled
 
@@ -962,18 +1243,27 @@ class ServingEngine:
         for widx, dev in enumerate(self.devices):
             for tier in cache_tiers:
                 for n in sizes:
-                    fwd = self._forward_for((hp, wp), n, worker=widx,
-                                            tier=tier)
-                    zeros = np.zeros((n, hp, wp, 3), np.uint8)
-                    out = fwd(self._worker_vars[widx],
-                              jax.device_put(zeros, dev),
-                              jax.device_put(zeros.copy(), dev))
-                    jax.block_until_ready(out)
-                    self._note_warm(widx, (hp, wp), n, tier)
-        log.info("prewarmed bucket %dx%d batch sizes %s (%d executable "
-                 "famil%s) on %d worker(s)", hp, wp, sizes,
-                 len(cache_tiers), "y" if len(cache_tiers) == 1 else "ies",
-                 len(self.devices))
+                    for family in self._families():
+                        fwd = self._forward_for((hp, wp), n, worker=widx,
+                                                tier=tier, family=family)
+                        zeros = np.zeros((n, hp, wp, 3), np.uint8)
+                        args = [self._worker_vars[widx],
+                                jax.device_put(zeros, dev),
+                                jax.device_put(zeros.copy(), dev)]
+                        if family == FAMILY_WARM:
+                            f = (self._tier_models[tier]
+                                 .config.downsample_factor)
+                            args.append(jax.device_put(
+                                np.zeros((n, hp // f, wp // f),
+                                         np.float32), dev))
+                        out = fwd(*args)
+                        jax.block_until_ready(out)
+                        self._note_warm(widx, (hp, wp), n, tier, family)
+        log.info("prewarmed bucket %dx%d batch sizes %s (%d tier "
+                 "famil%s x %d program variant(s)) on %d worker(s)",
+                 hp, wp, sizes, len(cache_tiers),
+                 "y" if len(cache_tiers) == 1 else "ies",
+                 len(self._families()), len(self.devices))
 
     # --------------------------------------------------------------- workers
     def _worker_loop(self, widx: int) -> None:
@@ -1114,7 +1404,8 @@ class ServingEngine:
         t_pickup = time.monotonic()
         waits = [t_pickup - r.t_enqueue for r in batch]
         bucket = batch[0].bucket
-        tier = batch[0].tier       # queue groups by (bucket, tier)
+        tier = batch[0].tier       # queue groups by (bucket, tier, family)
+        family = batch[0].family
         n = len(batch)
 
         # Sampled requests: the queue leg ends at worker pickup; the
@@ -1142,14 +1433,22 @@ class ServingEngine:
             # compiles (make_forward), so that bucket stays bitwise-equal
             # to solo inference; n > 1 amortizes the fixed per-dispatch
             # work across a real batch axis with zero filler frames.
-            fwd = self._forward_for(bucket, n, worker=widx, tier=tier)
+            fwd = self._forward_for(bucket, n, worker=widx, tier=tier,
+                                    family=family)
             adaptive = early_exit_enabled(
                 self._tier_models[self._cache_tier(tier)].config)
             p1 = np.stack([r.payload.left for r in batch])
             p2 = np.stack([r.payload.right for r in batch])
-            out = fwd(self._worker_vars[widx],
-                      jax.device_put(p1, device),
-                      jax.device_put(p2, device))
+            args = [self._worker_vars[widx],
+                    jax.device_put(p1, device),
+                    jax.device_put(p2, device)]
+            if family == FAMILY_WARM:
+                # Warm session frames: the batch's previous-frame states
+                # stack into the program's flow_init input.
+                fi = np.stack([r.payload.flow_init for r in batch]
+                              ).astype(np.float32)
+                args.append(jax.device_put(fi, device))
+            out = fwd(*args)
             # Advisory device clock: honest on a local backend; behind an
             # async tunnel readiness reports at dispatch (profiling.py) and
             # only the fetch below is a real stop clock.
@@ -1158,11 +1457,23 @@ class ServingEngine:
         p_ready = time.perf_counter() if sampled else 0.0
 
         with profiling.annotate("serve.fetch"):
-            if adaptive:
-                flows, iters_used_dev = out
-                iters_used = int(iters_used_dev)  # one extra scalar fetch
+            flow_low_padded = None
+            if family is FAMILY_BASE:
+                if adaptive:
+                    flows, iters_used_dev = out
+                    iters_used = int(iters_used_dev)  # extra scalar fetch
+                else:
+                    flows, iters_used = out, self.serve_cfg.iters
             else:
-                flows, iters_used = out, self.serve_cfg.iters
+                # Session families also return the padded low-res state
+                # (and, adaptive, the trip count): (flow_up, flow_low[,
+                # iters_used]) — eval/runner.make_forward.
+                if adaptive:
+                    flows, flow_low, iters_used_dev = out
+                    iters_used = int(iters_used_dev)
+                else:
+                    (flows, flow_low), iters_used = out, self.serve_cfg.iters
+                flow_low_padded = np.asarray(flow_low)  # (n, Hp/f, Wp/f)
             flows_padded = np.asarray(flows)      # (n, Hp, Wp)
         t_fetched = time.monotonic()
         p_fetched = time.perf_counter() if sampled else 0.0
@@ -1210,8 +1521,8 @@ class ServingEngine:
                 self.metrics.dispatched_flops.inc(rec.flops)
                 self._mfu.note(rec.flops)
         self.metrics.note_batch_done()
-        self._note_warm(widx, bucket, n, self._cache_tier(tier))
-        for r, fp, wait in zip(batch, flows_padded, waits):
+        self._note_warm(widx, bucket, n, self._cache_tier(tier), family)
+        for i, (r, fp, wait) in enumerate(zip(batch, flows_padded, waits)):
             exemplar = r.trace.trace_id if r.trace is not None else None
             p_respond = time.perf_counter() if exemplar is not None else 0.0
             flow = r.payload.padder.unpad(fp[None])[0]
@@ -1225,7 +1536,14 @@ class ServingEngine:
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
                 batch_size=n, iters_used=iters_used, tier=tier,
-                requested_tier=r.requested_tier, attempts=r.attempts + 1))
+                requested_tier=r.requested_tier, attempts=r.attempts + 1,
+                session_id=r.session_id,
+                frame_index=r.payload.frame_index,
+                warm=(family == FAMILY_WARM),
+                scene_cut=r.payload.scene_cut,
+                frame_delta=r.payload.frame_delta,
+                flow_low=(np.ascontiguousarray(flow_low_padded[i])
+                          if flow_low_padded is not None else None)))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

@@ -28,6 +28,26 @@ Endpoints:
   Under brownout degradation a response served at a cheaper tier than
   requested carries ``X-Degraded: <requested>-><served>``; the
   ``X-No-Degrade`` request header opts one request out.
+* ``POST /v1/stream/<session-id>`` — one FRAME of a streaming stereo
+  session (warm-start video serving, serving/sessions.py).  Body,
+  content types, ``?tier=`` / ``X-Tier``, ``X-Deadline-Ms``, and the
+  response encodings are exactly ``/v1/disparity``; the session id rides
+  the path (or the ``X-Session-Id`` header when the path is bare
+  ``/v1/stream``).  The first frame of a new id creates the session and
+  cold-starts; subsequent frames warm-start the GRU from the previous
+  frame's disparity unless the scene-cut check fires.  Responses carry
+  ``X-Session-Id``, ``X-Frame-Index``, ``X-Warm: 0|1``,
+  ``X-Scene-Cut: 1`` (when the inter-frame delta check forced a cold
+  start), ``X-Frame-Delta`` (the measured delta), and ``X-Iters-Used``.
+  Session errors are typed: **410** ``{"error": "session_expired",
+  "reason": "expired"|"evicted"|"closed"}`` on a dead id (open a new
+  session), 400 ``{"error": "sessions_disabled"}`` when the engine runs
+  stateless.  Frames of ONE session are strictly ordered (a frame
+  blocks while the previous one is in flight); stream different
+  sessions concurrently for pipelining.
+* ``DELETE /v1/stream/<session-id>`` — close the session; 200 with its
+  lifetime stats (frames, warm/cold split, scene cuts, mean GRU
+  iterations), 404 on an unknown id, 410 on an already-dead one.
 * ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
 * ``GET /healthz`` — LIVENESS: one JSON line (status, queue depth,
   inflight count, last-batch age, device count, readiness) answered
@@ -70,6 +90,7 @@ import numpy as np
 from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
                                              RequestPoisoned)
 from raft_stereo_tpu.serving.service import StereoService
+from raft_stereo_tpu.serving.sessions import SessionExpired, SessionsDisabled
 from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
 from raft_stereo_tpu.telemetry.http import (handle_debug_get,
                                             handle_debug_post,
@@ -113,6 +134,18 @@ def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
         Image.fromarray(enc).save(buf, format="PNG")
         return buf.getvalue(), "image/png"
     raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+
+
+def _stream_session_id(path: str, headers) -> Optional[str]:
+    """The session id of one ``/v1/stream`` request: the path segment
+    (``/v1/stream/<id>``, the canonical spelling) or the
+    ``X-Session-Id`` header on the bare path.  None when the path is not
+    a stream route at all."""
+    if path == "/v1/stream":
+        return headers.get("X-Session-Id") or ""
+    if path.startswith("/v1/stream/"):
+        return path[len("/v1/stream/"):]
+    return None
 
 
 def make_handler(service: StereoService,
@@ -164,6 +197,9 @@ def make_handler(service: StereoService,
                     "anomalies": service.metrics.anomalies.value,
                     "brownout_level":
                         service.metrics.brownout_level.value,
+                    "sessions_active": (
+                        service.sessions.active_count
+                        if service.sessions is not None else None),
                     "devices": len(service.devices)})
             elif path == "/readyz":
                 status = service.warm_status()
@@ -185,10 +221,15 @@ def make_handler(service: StereoService,
                 return
             if handle_debug_post(url.path, recorder, self._reply_json):
                 return
-            if url.path != "/v1/disparity":
+            session_id = _stream_session_id(url.path, self.headers)
+            if url.path != "/v1/disparity" and session_id is None:
                 self._reply_json(404, {"error": f"no route {url.path!r}"})
                 return
             try:
+                if session_id == "":
+                    raise ValueError(
+                        "stream frames need a session id: POST "
+                        "/v1/stream/<id> or set X-Session-Id")
                 length = int(self.headers.get("Content-Length", 0))
                 if not 0 < length <= MAX_BODY_BYTES:
                     raise ValueError(f"Content-Length {length} out of range")
@@ -212,8 +253,27 @@ def make_handler(service: StereoService,
                 self._reply_json(400, {"error": str(e)})
                 return
             try:
-                result = service.infer(left, right, deadline_ms=deadline_ms,
-                                       tier=tier, degradable=degradable)
+                if session_id is not None:
+                    result = service.infer_session(
+                        session_id, left, right, deadline_ms=deadline_ms,
+                        tier=tier, degradable=degradable)
+                else:
+                    result = service.infer(left, right,
+                                           deadline_ms=deadline_ms,
+                                           tier=tier, degradable=degradable)
+            except SessionsDisabled as e:
+                self._reply_json(400, {"error": "sessions_disabled",
+                                       "detail": str(e)})
+                return
+            except SessionExpired as e:
+                # The typed dead-session contract: 410 Gone — the client
+                # must open a fresh session (a silent cold restart would
+                # hide the stream break).
+                self._reply_json(410, {"error": "session_expired",
+                                       "session_id": e.session_id,
+                                       "reason": e.reason,
+                                       "detail": str(e)})
+                return
             except Overloaded as e:
                 # Typed overload contract: machine-readable body + the
                 # matching Retry-After, so clients back off instead of
@@ -253,7 +313,43 @@ def make_handler(service: StereoService,
             if result.degraded:
                 headers.append(("X-Degraded",
                                 f"{result.requested_tier}->{result.tier}"))
+            if result.session_id is not None:
+                headers.append(("X-Session-Id", result.session_id))
+                headers.append(("X-Frame-Index", str(result.frame_index)))
+                headers.append(("X-Warm", "1" if result.warm else "0"))
+                if result.scene_cut:
+                    headers.append(("X-Scene-Cut", "1"))
+                if result.frame_delta is not None:
+                    headers.append(("X-Frame-Delta",
+                                    f"{result.frame_delta:.2f}"))
             self._reply(200, payload, ctype, extra_headers=headers)
+
+        def do_DELETE(self):
+            url = urlparse(self.path)
+            session_id = _stream_session_id(url.path, self.headers)
+            if session_id is None:
+                self._reply_json(404, {"error": f"no route {url.path!r}"})
+                return
+            if session_id == "":
+                self._reply_json(400, {"error": "stream close needs a "
+                                                "session id"})
+                return
+            try:
+                stats = service.close_session(session_id)
+            except SessionsDisabled as e:
+                self._reply_json(400, {"error": "sessions_disabled",
+                                       "detail": str(e)})
+                return
+            except SessionExpired as e:
+                self._reply_json(410, {"error": "session_expired",
+                                       "session_id": e.session_id,
+                                       "reason": e.reason})
+                return
+            except KeyError:
+                self._reply_json(404, {"error": "unknown_session",
+                                       "session_id": session_id})
+                return
+            self._reply_json(200, {"status": "closed", **stats})
 
     return Handler
 

@@ -33,6 +33,12 @@ PADDING_WASTE_BUCKETS = (0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
 # headroom past it.
 ITERS_USED_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
 
+# Inter-frame delta buckets for serve_session_frame_delta: mean
+# |Δintensity| (0..255) between consecutive frames' thumbnails.  Video at
+# normal motion sits in the low single digits; a hard scene cut jumps
+# past the default 40-unit threshold, hence the wide top end.
+FRAME_DELTA_BUCKETS = (0.5, 1, 2, 4, 8, 16, 32, 64, 128, 255)
+
 
 class ServingMetrics:
     """The serving subsystem's standard instrument set, in one place so the
@@ -154,6 +160,38 @@ class ServingMetrics:
             "serve_mfu",
             "model FLOP utilization: achieved FLOP/s / device peak (0 "
             "without cost telemetry or with an unknown peak)")
+        # Streaming-session instruments (serving/sessions.py +
+        # engine.submit_session): the warm-start story's audit trail —
+        # how many streams are live, how their frames split warm vs cold,
+        # and how temporally coherent the traffic actually is (the
+        # inter-frame delta the scene-cut fallback gates on).
+        self.sessions_active = r.gauge(
+            "serve_sessions_active",
+            "live streaming sessions holding warm-start state")
+        self.sessions_created = r.counter(
+            "serve_sessions_created_total", "streaming sessions opened")
+        self.sessions_expired = r.counter(
+            "serve_sessions_expired_total",
+            "streaming sessions expired by the TTL sweep")
+        self.sessions_evicted = r.counter(
+            "serve_sessions_evicted_total",
+            "streaming sessions evicted at LRU capacity")
+        self.scene_cuts = r.counter(
+            "serve_session_scene_cuts_total",
+            "session frames that fell back to a cold start because the "
+            "inter-frame delta check failed (scene cut)")
+        self.session_reseeds = r.counter(
+            "serve_session_reseeds_total",
+            "session states dropped by the keyframe guard: a warm frame "
+            "ran to the iteration cap without converging, so the next "
+            "frame cold-starts (session_reseed_on_cap)")
+        self.frame_delta = r.histogram(
+            "serve_session_frame_delta",
+            "mean |delta intensity| (0..255) between consecutive session "
+            "frames' thumbnails — the scene-cut gate's input",
+            buckets=FRAME_DELTA_BUCKETS)
+        self._session_frame_lock = threading.Lock()
+        self._session_frames_by_mode: Dict[str, Counter] = {}
         self._bucket_lock = threading.Lock()
         self._bucket_px: Dict[str, Tuple[Counter, Counter]] = {}
         # Adaptive early-exit accounting (serving/engine.py per-tier
@@ -249,6 +287,26 @@ class ServingMetrics:
         first dispatch — what the smoke/bench harnesses assert on."""
         with self._iters_lock:
             return self._iters_by_tier.get(tier)
+
+    def observe_session_frame(self, mode: str) -> None:
+        """Count one completed session frame into the per-mode
+        ``serve_session_frames_total{mode="warm"|"cold"}`` family — the
+        warm-vs-cold split the streaming smoke asserts on."""
+        with self._session_frame_lock:
+            c = self._session_frames_by_mode.get(mode)
+            if c is None:
+                c = self.registry.counter(
+                    "serve_session_frames_total",
+                    "streaming session frames served, by warm/cold start",
+                    labels={"mode": mode})
+                self._session_frames_by_mode[mode] = c
+        c.inc()
+
+    def session_frames(self, mode: str) -> int:
+        """Completed session frames for one mode (0 before the first)."""
+        with self._session_frame_lock:
+            c = self._session_frames_by_mode.get(mode)
+        return 0 if c is None else c.value
 
     def dispatches_at(self, batch_size: int) -> int:
         """Dispatch count for one batch-size bucket (0 if never used)."""

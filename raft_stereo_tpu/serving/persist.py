@@ -14,7 +14,11 @@ This module makes prewarm disk-bound instead of compile-bound:
   is a SHA-256 over everything that invalidates an executable: jax
   version, backend platform + version, device kind, the model config
   JSON, padded shape, batch size, tier knobs, GRU depth, fetch dtype,
-  and donation — a new jax wheel or a config change misses cleanly and
+  donation, and the executable FAMILY / flow_init arity (the round-14
+  warm-start programs take an extra traced input and return the low-res
+  state, so warm and cold variants of one (config, shape, batch, tier)
+  must never collide on one key — engine._disk_key passes both
+  coordinates) — a new jax wheel or a config change misses cleanly and
   recompiles (stale entries are just dead files, never wrong programs).
 * ``enable_persistent_compilation_cache`` — turns on jax's own
   persistent compilation cache in the same directory, which also covers

@@ -1,0 +1,328 @@
+"""Streaming stereo sessions: the temporal state behind warm-start video
+serving.
+
+RAFT-Stereo inherits RAFT's warm start (Teed & Deng, ECCV 2020;
+arXiv 2109.07547 §3): the GRU refinement loop accepts an initial
+disparity field (``flow_init``, models/raft_stereo.py), and initializing
+frame t+1 from frame t's converged low-res disparity lets the
+convergence-gated loop (round 12) stall after a fraction of the
+iterations a cold zero-init needs.  The engine was stateless, so that
+win was unreachable: this module holds the per-stream state — one
+``StereoSession`` per client stream mapping session id → the previous
+frame's padded low-res x-flow, a grayscale thumbnail for the scene-cut
+check, and bookkeeping — under a thread-safe TTL + LRU store.
+
+Design points:
+
+* **TTL expiry + LRU capacity eviction.**  A session that stops sending
+  frames is garbage after ``ttl_s`` (a stale disparity field is a bad
+  init anyway — the scene moved on), and the store holds at most
+  ``capacity`` live sessions, evicting the least-recently-used beyond
+  that.  Both removals leave a bounded **tombstone** so the next frame
+  on a dead id fails with the typed ``SessionExpired`` (the HTTP layer's
+  410) instead of silently cold-restarting mid-stream — the client must
+  acknowledge the break and open a fresh session.  Tombstones age out
+  after ``ttl_s``, so an id becomes reusable once the break is old news.
+* **Per-session frame ordering.**  Warm start is a frame-to-frame chain:
+  frame t+1's init IS frame t's output, so two frames of one session
+  must never be in flight at once (the second would read stale state,
+  and a batcher could reorder them within a dispatch cycle).  Each
+  session carries an ordering lock the engine holds from submit until
+  the frame's future resolves — one frame per session in the pipeline,
+  strict submission order, while *different* sessions batch together
+  freely.
+* **Scene-cut fallback.**  Warm start helps only while frames are
+  temporally coherent.  ``frame_delta`` — the mean |Δintensity| between
+  consecutive frames' mean-pooled grayscale thumbnails — is compared
+  against the engine's threshold; a cut falls back to a cold start (and
+  the session keeps streaming: state re-seeds from the cold frame).
+
+The store never touches JAX: like the batcher, every policy here is
+testable in milliseconds (tests/test_sessions.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# Pooling factor of the scene-cut thumbnails: coarse enough that the
+# per-frame host cost is trivial (~Kb), fine enough that a real scene
+# change moves the mean intensity delta far past camera noise.
+THUMB_POOL = 16
+
+
+class SessionsDisabled(RuntimeError):
+    """Streaming was requested but the engine runs without a session
+    store (``ServeConfig.sessions=False``).  The HTTP layer maps this to
+    a typed 400."""
+
+
+class SessionExpired(KeyError):
+    """The typed dead-session failure (HTTP 410): the id was live once
+    but its session expired (TTL), was evicted (LRU capacity), or was
+    closed — the client must open a fresh session.  ``reason`` is one of
+    ``"expired"`` / ``"evicted"`` / ``"closed"``."""
+
+    def __init__(self, session_id: str, reason: str):
+        super().__init__(f"session {session_id!r} {reason}; open a new "
+                         f"session to keep streaming")
+        self.session_id = session_id
+        self.reason = reason
+
+
+def frame_thumbnail(image: np.ndarray, pool: int = THUMB_POOL) -> np.ndarray:
+    """Mean-pooled grayscale thumbnail of one (H, W, 3) frame — the
+    cheap host-side signature the scene-cut delta compares.  Pure NumPy,
+    microseconds at video shapes."""
+    gray = np.asarray(image, dtype=np.float32).mean(axis=-1)
+    h, w = gray.shape
+    hp, wp = h - h % pool, w - w % pool
+    if hp >= pool and wp >= pool:
+        gray = gray[:hp, :wp].reshape(hp // pool, pool,
+                                      wp // pool, pool).mean(axis=(1, 3))
+    return gray
+
+
+def frame_delta(thumb_a: Optional[np.ndarray],
+                thumb_b: Optional[np.ndarray]) -> Optional[float]:
+    """Mean |Δintensity| (0..255) between two frame thumbnails; None when
+    either side is missing or the shapes disagree (a resolution change is
+    its own cold-start reason, not a measurable delta)."""
+    if thumb_a is None or thumb_b is None or thumb_a.shape != thumb_b.shape:
+        return None
+    return float(np.mean(np.abs(thumb_a - thumb_b)))
+
+
+@dataclasses.dataclass
+class StereoSession:
+    """One client stream's temporal state.  ``flow_low`` is the previous
+    frame's PADDED low-res x-flow (= -disparity, shape
+    (Hp/f, Wp/f) float32) — exactly the tensor the model's ``flow_init``
+    consumes; ``None`` until the first frame completes.  Mutated only
+    under the store lock or while the session's ordering lock is held."""
+
+    session_id: str
+    created_mono: float
+    last_used_mono: float
+    bucket: Optional[Tuple[int, int]] = None   # padded (Hp, Wp) of state
+    raw_shape: Optional[Tuple[int, int]] = None
+    flow_low: Optional[np.ndarray] = None
+    thumb: Optional[np.ndarray] = None
+    frame_index: int = 0          # frames COMPLETED (the next frame's index)
+    warm_frames: int = 0
+    cold_frames: int = 0
+    scene_cuts: int = 0
+    iters_used_sum: int = 0
+    iters_used_frames: int = 0
+    # Frame-ordering lock (see module docstring): held from submit until
+    # the frame's future resolves, so one session never has two frames
+    # in flight and a dispatch cycle can never reorder them.
+    order_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
+
+    def note_result(self, flow_low: Optional[np.ndarray],
+                    thumb: Optional[np.ndarray],
+                    bucket: Tuple[int, int], raw_shape: Tuple[int, int],
+                    warm: bool, iters_used: Optional[int]) -> None:
+        """Fold one completed frame into the state (called by the engine
+        while ``order_lock`` is held, so no torn reads are possible).
+        ``flow_low=None`` drops the warm-start state — the engine's
+        keyframe guard passes None when the frame never converged, so
+        the next frame cold-starts."""
+        self.flow_low = flow_low
+        self.thumb = thumb
+        self.bucket = tuple(bucket)
+        self.raw_shape = tuple(raw_shape)
+        self.frame_index += 1
+        if warm:
+            self.warm_frames += 1
+        else:
+            self.cold_frames += 1
+        if iters_used is not None:
+            self.iters_used_sum += int(iters_used)
+            self.iters_used_frames += 1
+
+    def iters_used_mean(self) -> Optional[float]:
+        """Per-session mean GRU trip count — the number the close stats
+        and the streaming bench report per stream."""
+        if not self.iters_used_frames:
+            return None
+        return self.iters_used_sum / self.iters_used_frames
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "session_id": self.session_id,
+            "frames": self.frame_index,
+            "warm_frames": self.warm_frames,
+            "cold_frames": self.cold_frames,
+            "scene_cuts": self.scene_cuts,
+            "iters_used_mean": (round(self.iters_used_mean(), 3)
+                                if self.iters_used_mean() is not None
+                                else None),
+        }
+
+
+class SessionStore:
+    """Thread-safe session table: id → ``StereoSession`` with TTL expiry,
+    LRU capacity eviction, and tombstoned removal (``SessionExpired``).
+
+    ``clock`` is injectable (tests pin expiry deterministically).  The
+    optional ``active_gauge`` / ``expired_counter`` / ``evicted_counter``
+    instruments keep ``serve_sessions_*`` live without the store
+    importing the metrics module."""
+
+    def __init__(self, capacity: int = 256, ttl_s: float = 30.0,
+                 clock=time.monotonic, active_gauge=None,
+                 created_counter=None, expired_counter=None,
+                 evicted_counter=None):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity} must be >= 1")
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s={ttl_s} must be > 0")
+        self.capacity = capacity
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, StereoSession]" = OrderedDict()
+        # id -> (reason, tombstone_mono); bounded at 4x capacity and aged
+        # out after ttl_s, so dead ids 410 for one TTL window and then
+        # become creatable again.
+        self._tombstones: "OrderedDict[str, Tuple[str, float]]" = (
+            OrderedDict())
+        self._active_gauge = active_gauge
+        self._created = created_counter
+        self._expired = expired_counter
+        self._evicted = evicted_counter
+
+    # ----------------------------------------------------------- internals
+    def _note_active(self) -> None:
+        if self._active_gauge is not None:
+            self._active_gauge.set(len(self._sessions))
+
+    def _bury(self, sid: str, reason: str, now: float) -> None:
+        self._tombstones[sid] = (reason, now)
+        self._tombstones.move_to_end(sid)
+        while len(self._tombstones) > 4 * self.capacity:
+            self._tombstones.popitem(last=False)
+        if reason == "expired" and self._expired is not None:
+            self._expired.inc()
+        if reason == "evicted" and self._evicted is not None:
+            self._evicted.inc()
+
+    def _sweep_locked(self, now: float) -> None:
+        """Expire TTL-stale sessions and aged-out tombstones.  Sessions
+        iterate in last-used order (every touch moves to the back), so
+        the scan stops at the first live one.  A session whose ordering
+        lock is held has a frame IN FLIGHT (a first-frame compile can
+        outlast a short TTL) — it is skipped, and the frame's completion
+        callback touches it back to freshness."""
+        expired = []
+        for sid, sess in self._sessions.items():
+            if now - sess.last_used_mono <= self.ttl_s:
+                break
+            if sess.order_lock.locked():
+                continue
+            expired.append(sid)
+        for sid in expired:
+            del self._sessions[sid]
+            self._bury(sid, "expired", now)
+        while self._tombstones:
+            sid, (_reason, t) = next(iter(self._tombstones.items()))
+            if now - t <= self.ttl_s:
+                break
+            del self._tombstones[sid]
+        self._note_active()
+
+    def _check_tombstone_locked(self, sid: str) -> None:
+        entry = self._tombstones.get(sid)
+        if entry is not None:
+            raise SessionExpired(sid, entry[0])
+
+    # -------------------------------------------------------------- surface
+    def get_or_create(self, sid: str) -> Tuple[StereoSession, bool]:
+        """The session for ``sid``, creating it on first use.  Returns
+        ``(session, created)``.  Raises ``SessionExpired`` when the id is
+        tombstoned (expired / evicted / closed within the last TTL
+        window) — the 410 contract: a broken stream must be re-opened
+        explicitly, never silently restarted."""
+        now = self._clock()
+        with self._lock:
+            self._sweep_locked(now)
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                sess.last_used_mono = now
+                self._sessions.move_to_end(sid)
+                return sess, False
+            self._check_tombstone_locked(sid)
+            while len(self._sessions) >= self.capacity:
+                evicted_id, _ = self._sessions.popitem(last=False)
+                self._bury(evicted_id, "evicted", now)
+            sess = StereoSession(session_id=sid, created_mono=now,
+                                 last_used_mono=now)
+            self._sessions[sid] = sess
+            if self._created is not None:
+                self._created.inc()
+            self._note_active()
+            return sess, True
+
+    def get(self, sid: str) -> StereoSession:
+        """The live session for ``sid``; ``SessionExpired`` on a
+        tombstone, plain ``KeyError`` on an id this store never saw."""
+        now = self._clock()
+        with self._lock:
+            self._sweep_locked(now)
+            sess = self._sessions.get(sid)
+            if sess is None:
+                self._check_tombstone_locked(sid)
+                raise KeyError(sid)
+            sess.last_used_mono = now
+            self._sessions.move_to_end(sid)
+            return sess
+
+    def touch(self, sid: str) -> None:
+        """Refresh ``sid``'s last-used stamp (no-op on unknown ids) —
+        the frame-completion callback calls this so a long dispatch
+        counts as activity, not idleness."""
+        now = self._clock()
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                sess.last_used_mono = now
+                self._sessions.move_to_end(sid)
+
+    def close(self, sid: str) -> Dict[str, object]:
+        """End one session deliberately: removes it and returns its
+        lifetime stats (the DELETE response body).  The id tombstones as
+        ``"closed"`` for one TTL window so a straggler frame racing the
+        close gets the typed 410, not a silent new session."""
+        now = self._clock()
+        with self._lock:
+            self._sweep_locked(now)
+            sess = self._sessions.pop(sid, None)
+            if sess is None:
+                self._check_tombstone_locked(sid)
+                raise KeyError(sid)
+            self._bury(sid, "closed", now)
+            self._note_active()
+        return sess.stats()
+
+    def sweep(self) -> None:
+        """Eagerly expire TTL-stale sessions (every access sweeps too —
+        this is for idle-time housekeeping / tests)."""
+        with self._lock:
+            self._sweep_locked(self._clock())
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            self._sweep_locked(self._clock())
+            return len(self._sessions)
+
+    def __len__(self) -> int:
+        return self.active_count

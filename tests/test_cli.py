@@ -71,6 +71,29 @@ def test_demo_cli(tmp_path, tiny_checkpoint):
     assert disp.shape == (64, 96) and np.isfinite(disp).all()
 
 
+def test_demo_cli_sequence_mode(tmp_path, tiny_checkpoint, caplog):
+    """--sequence runs the frames in order with warm-start chaining and
+    logs per-frame iters_used + cumulative FPS (round-14 satellite)."""
+    import logging
+
+    from raft_stereo_tpu.cli.demo import main
+
+    root = _make_kitti_tree(tmp_path / "KITTI")
+    out = tmp_path / "seq_out"
+    with caplog.at_level(logging.INFO):
+        main(["--restore_ckpt", tiny_checkpoint,
+              "-l", str(root / "training" / "image_2" / "*_10.png"),
+              "-r", str(root / "training" / "image_3" / "*_10.png"),
+              "--output_directory", str(out), "--sequence",
+              "--valid_iters", "2", "--exit_threshold_px", "1e9"])
+    pngs = sorted(glob.glob(str(out / "*-disparity.png")))
+    assert len(pngs) == 3
+    text = caplog.text
+    assert "frame 0 cold" in text
+    assert "frame 1 warm" in text and "frame 2 warm" in text
+    assert "cumulative" in text and "sequence done" in text
+
+
 def test_evaluate_cli(tmp_path, tiny_checkpoint, capsys):
     from raft_stereo_tpu.cli.evaluate import main
 
@@ -81,6 +104,29 @@ def test_evaluate_cli(tmp_path, tiny_checkpoint, capsys):
                     "--valid_iters", "2", "--max_images", "2"])
     assert "kitti-epe" in results and "kitti-d1" in results
     assert np.isfinite(results["kitti-epe"])
+
+
+def test_evaluate_cli_sequence_mode(tmp_path, tiny_checkpoint):
+    """--sequence reports warm-start EPE drift vs cold per-frame
+    inference and records it to --stream_out (round-14 satellite)."""
+    import json
+
+    from raft_stereo_tpu.cli.evaluate import main
+
+    _make_kitti_tree(tmp_path / "KITTI")
+    out = tmp_path / "STREAM_test.json"
+    results = main(["--restore_ckpt", tiny_checkpoint,
+                    "--dataset", "kitti", "--data_root", str(tmp_path),
+                    "--valid_iters", "2", "--max_images", "2",
+                    "--sequence", "--stream_out", str(out)])
+    for key in ("kitti-epe-cold", "kitti-epe-warm",
+                "kitti-warm-drift-epe"):
+        assert key in results and np.isfinite(results[key])
+    assert results["kitti-warm-drift-epe"] == pytest.approx(
+        results["kitti-epe-warm"] - results["kitti-epe-cold"])
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "warm_start_sequence_drift"
+    assert rec["dataset"] == "kitti" and "results" in rec
 
 
 def test_train_loop_and_exact_resume(tmp_path):

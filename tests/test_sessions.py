@@ -1,0 +1,619 @@
+"""Streaming-session tests (tier-1, CPU): the round-14 warm-start video
+layer.
+
+Store tests run against the bare ``SessionStore`` — no JAX — so TTL
+expiry, LRU eviction, tombstone semantics, and concurrency are exercised
+in milliseconds with an injected clock.  Runner/engine tests use the same
+tiny pure-XLA model as test_serving.py; the headline pins are the ISSUE
+acceptance properties: (a) the sessionless path and session COLD frames
+are bitwise-equal to the pre-session build (same program for the former,
+same math for the latter), (b) a zero warm init reproduces the cold
+output bitwise (``disp = 0 + flow_init``), (c) session frames chain
+in order and never share a dispatch with another family, (d) dead
+sessions fail with the typed ``SessionExpired`` → HTTP 410, and (e) the
+warm executable families join prewarm and the /readyz target and get
+distinct persistent-cache keys.
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.serving.sessions import (SessionExpired,
+                                              SessionsDisabled,
+                                              SessionStore, frame_delta,
+                                              frame_thumbnail)
+
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+ITERS = 1
+
+
+# ------------------------------------------------------------ session store
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_store_ttl_expiry_typed_and_tombstone_ages_out():
+    clock = FakeClock()
+    store = SessionStore(capacity=4, ttl_s=10.0, clock=clock)
+    sess, created = store.get_or_create("a")
+    assert created and store.active_count == 1
+    clock.t += 5.0
+    _, created = store.get_or_create("a")      # touch keeps it alive
+    assert not created
+    clock.t += 10.1                            # past TTL since last touch
+    with pytest.raises(SessionExpired) as e:
+        store.get_or_create("a")
+    assert e.value.reason == "expired" and e.value.session_id == "a"
+    assert store.active_count == 0
+    # SessionExpired is a KeyError subclass (store.get contract)
+    with pytest.raises(KeyError):
+        store.get("a")
+    # the tombstone itself ages out after another TTL: the id is fresh
+    clock.t += 10.1
+    _, created = store.get_or_create("a")
+    assert created
+
+
+def test_store_lru_eviction_at_capacity():
+    clock = FakeClock()
+    store = SessionStore(capacity=2, ttl_s=100.0, clock=clock)
+    store.get_or_create("a")
+    clock.t += 1
+    store.get_or_create("b")
+    clock.t += 1
+    store.get_or_create("a")                   # refresh: b is now LRU
+    clock.t += 1
+    store.get_or_create("c")                   # evicts b
+    assert store.active_count == 2
+    with pytest.raises(SessionExpired) as e:
+        store.get_or_create("b")
+    assert e.value.reason == "evicted"
+    store.get_or_create("a")                   # survivors unaffected
+    store.get_or_create("c")
+
+
+def test_store_close_returns_stats_and_tombstones():
+    store = SessionStore(capacity=4, ttl_s=100.0, clock=FakeClock())
+    sess, _ = store.get_or_create("cam")
+    sess.note_result(flow_low=np.zeros((4, 4), np.float32),
+                     thumb=None, bucket=(32, 32), raw_shape=(30, 30),
+                     warm=False, iters_used=3)
+    sess.note_result(flow_low=np.zeros((4, 4), np.float32),
+                     thumb=None, bucket=(32, 32), raw_shape=(30, 30),
+                     warm=True, iters_used=1)
+    stats = store.close("cam")
+    assert stats["frames"] == 2 and stats["warm_frames"] == 1
+    assert stats["iters_used_mean"] == 2.0
+    with pytest.raises(SessionExpired) as e:
+        store.get_or_create("cam")
+    assert e.value.reason == "closed"
+    with pytest.raises(KeyError):
+        store.close("never-existed")
+
+
+def test_store_inflight_session_immune_to_sweep():
+    """A frame in flight (ordering lock held) longer than the TTL must
+    not expire its session mid-dispatch — the completion callback
+    touches it back to freshness (the first-frame-compile case)."""
+    clock = FakeClock()
+    store = SessionStore(capacity=4, ttl_s=1.0, clock=clock)
+    sess, _ = store.get_or_create("slow")
+    assert sess.order_lock.acquire(timeout=1)
+    clock.t += 100.0                           # way past TTL, but in flight
+    assert store.active_count == 1             # sweep skipped it
+    store.touch("slow")
+    sess.order_lock.release()
+    clock.t += 0.5
+    _, created = store.get_or_create("slow")
+    assert not created                         # still the same session
+
+
+def test_store_concurrent_access_two_clients():
+    """The satellite's concurrent two-client pin at the store level: two
+    threads hammering their own ids (plus overlap on a shared one) never
+    corrupt the table or double-create."""
+    store = SessionStore(capacity=64, ttl_s=100.0)
+    created_counts = {"x": 0, "y": 0, "shared": 0}
+    lock = threading.Lock()
+    errors = []
+
+    def client(own: str):
+        try:
+            for _ in range(200):
+                for sid in (own, "shared"):
+                    _, created = store.get_or_create(sid)
+                    if created:
+                        with lock:
+                            created_counts[sid] += 1
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(own,))
+               for own in ("x", "y")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert created_counts == {"x": 1, "y": 1, "shared": 1}
+    assert store.active_count == 3
+
+
+def test_frame_thumbnail_and_delta():
+    img = np.full((64, 96, 3), 100, np.uint8)
+    thumb = frame_thumbnail(img)
+    assert thumb.shape == (4, 6)
+    assert np.allclose(thumb, 100.0)
+    bright = frame_thumbnail(np.full((64, 96, 3), 228, np.uint8))
+    assert frame_delta(thumb, thumb) == 0.0
+    assert frame_delta(thumb, bright) == pytest.approx(128.0)
+    assert frame_delta(None, thumb) is None
+    assert frame_delta(thumb, frame_thumbnail(
+        np.zeros((32, 32, 3), np.uint8))) is None   # shape change
+
+
+# ------------------------------------------------------------------ runner
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+def _pair(hw=(48, 64), seed=3):
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+    return left, np.roll(left, -3, axis=1)
+
+
+def test_run_stream_cold_bitwise_parity_with_sessionless(tiny_model):
+    """The acceptance pin: a cold stream frame (no previous state) runs
+    the same math as the sessionless path — the extra flow_low output
+    changes nothing about flow_up, bitwise."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    runner = InferenceRunner(cfg, variables, iters=ITERS)
+    left, right = _pair()
+    flow, _ = runner(left, right)
+    frame = runner.run_stream(left, right)
+    assert not frame.warm and frame.iters_used is None
+    assert np.array_equal(frame.flow, flow), \
+        "cold stream frame must be bitwise-equal to the sessionless path"
+    f = cfg.downsample_factor
+    assert frame.flow_low.shape == (64 // f, 64 // f)  # padded low-res
+    assert frame.flow_low.dtype == np.float32
+
+
+def test_run_stream_zero_init_bitwise_equals_cold(tiny_model):
+    """disp = 0 + flow_init: a zero warm init must reproduce the cold
+    output bitwise — the warm program differs only by its seeding."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    runner = InferenceRunner(cfg, variables, iters=ITERS)
+    left, right = _pair(seed=5)
+    cold = runner.run_stream(left, right)
+    warm = runner.run_stream(left, right,
+                             prev_flow_low=np.zeros_like(cold.flow_low))
+    assert warm.warm
+    assert np.array_equal(warm.flow, cold.flow)
+    assert np.array_equal(warm.flow_low, cold.flow_low)
+
+
+def test_run_stream_state_mismatch_raises(tiny_model):
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    runner = InferenceRunner(cfg, variables, iters=ITERS)
+    left, right = _pair()
+    with pytest.raises(ValueError, match="low-res grid"):
+        runner.run_stream(left, right,
+                          prev_flow_low=np.zeros((3, 3), np.float32))
+
+
+def test_run_stream_early_exit_reports_iters(tiny_model):
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    runner = InferenceRunner(cfg, variables, iters=3,
+                             exit_threshold_px=1e-6, exit_min_iters=1)
+    left, right = _pair()
+    cold = runner.run_stream(left, right)
+    assert cold.iters_used is not None and 1 <= cold.iters_used <= 3
+    warm = runner.run_stream(left, right, prev_flow_low=cold.flow_low)
+    assert warm.iters_used is not None and runner.iters_used_mean() > 0
+
+
+# ------------------------------------------------------------------ engine
+def _structured(hw=(48, 64), level=40):
+    """A smooth structured frame (NOT noise: the scene-cut thumbnails
+    mean-pool, so only structured content moves the delta)."""
+    h, w = hw
+    ramp = np.linspace(0, 120, w, dtype=np.float32)[None, :] + level
+    img = np.broadcast_to(ramp, (h, w)).astype(np.uint8)
+    return np.stack([img] * 3, axis=-1)
+
+
+def test_engine_session_lifecycle_and_parity(tiny_model):
+    """Frame 0 cold + bitwise-equal to both the stateless engine path
+    and the solo runner; frame 1 warm with state chained; close returns
+    stats; a closed id 410s (SessionExpired)."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    solo = InferenceRunner(cfg, variables, iters=ITERS)
+    left, right = _pair()
+    solo_flow, _ = solo(left, right)
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, iters=ITERS,
+                                   sessions=True)) as svc:
+        stateless = svc.infer(left, right, timeout=300)
+        assert stateless.session_id is None and not stateless.warm
+        assert np.array_equal(stateless.flow, solo_flow)
+
+        f0 = svc.infer_session("cam", left, right, timeout=300)
+        assert (f0.session_id, f0.frame_index, f0.warm) == ("cam", 0,
+                                                            False)
+        assert np.array_equal(f0.flow, solo_flow), \
+            "session cold frame must be bitwise-equal to sessionless"
+        assert f0.flow_low is not None and f0.flow_low.dtype == np.float32
+
+        f1 = svc.infer_session("cam", left, right, timeout=300)
+        assert f1.warm and f1.frame_index == 1 and not f1.scene_cut
+        assert f1.frame_delta == pytest.approx(0.0)
+
+        assert svc.metrics.session_frames("cold") == 1
+        assert svc.metrics.session_frames("warm") == 1
+        text = svc.metrics.render_text()
+        assert "serve_sessions_active 1" in text
+        assert 'serve_session_frames_total{mode="warm"} 1' in text
+
+        stats = svc.close_session("cam")
+        assert stats["frames"] == 2 and stats["warm_frames"] == 1
+        with pytest.raises(SessionExpired) as e:
+            svc.infer_session("cam", left, right, timeout=300)
+        assert e.value.reason == "closed"
+
+
+def test_engine_scene_cut_falls_back_cold(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS, sessions=True,
+                                   scene_cut_threshold=40.0)) as svc:
+        a = _structured(level=20)
+        b = _structured(level=24)              # small drift: stays warm
+        c = 255 - _structured(level=20)        # inversion: hard cut
+        svc.infer_session("s", a, a.copy(), timeout=300)
+        f1 = svc.infer_session("s", b, b.copy(), timeout=300)
+        assert f1.warm and not f1.scene_cut
+        f2 = svc.infer_session("s", c, c.copy(), timeout=300)
+        assert not f2.warm and f2.scene_cut
+        assert f2.frame_delta is not None and f2.frame_delta > 40.0
+        assert svc.metrics.scene_cuts.value == 1
+        # the stream recovers: the cut frame re-seeded the state
+        f3 = svc.infer_session("s", c, c.copy(), timeout=300)
+        assert f3.warm and not f3.scene_cut
+        # delta histogram observed every warm-candidate frame
+        assert svc.metrics.frame_delta.count == 3
+
+
+def test_engine_session_frames_strictly_ordered(tiny_model):
+    """The dispatch-cycle ordering pin: frame N+1 of a session cannot
+    even ENTER the queue until frame N resolved — submitted concurrently
+    under a paused queue, frames complete in submission order and frame
+    N+1 warm-starts from frame N."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, iters=ITERS,
+                                   sessions=True)) as svc:
+        svc.infer_session("s", left, right, timeout=300)  # compile + seed
+        svc.queue.pause()
+        done = []
+        futs = {}
+
+        def frame(idx):
+            fut = svc.submit_session("s", left, right)
+            futs[idx] = fut
+            fut.add_done_callback(lambda f: done.append(idx))
+
+        t1 = threading.Thread(target=frame, args=(1,))
+        t1.start()
+        time.sleep(0.2)
+        t2 = threading.Thread(target=frame, args=(2,))
+        t2.start()
+        time.sleep(0.2)
+        # frame 2 is blocked on the session's ordering lock — not queued
+        assert svc.queue.depth == 1
+        svc.queue.resume()
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        r1 = futs[1].result(timeout=300)
+        r2 = futs[2].result(timeout=300)
+        assert done == [1, 2]
+        assert (r1.frame_index, r2.frame_index) == (1, 2)
+        assert r1.warm and r2.warm
+
+
+def test_engine_two_sessions_stream_concurrently(tiny_model):
+    """Concurrent two-client access: two sessions interleave frames
+    freely (only same-session frames serialize); both streams end fully
+    warm after frame 0 with their own state."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=2, iters=ITERS,
+                                   sessions=True)) as svc:
+        n_frames, results = 4, {}
+
+        def client(sid, seed):
+            left, right = _pair(seed=seed)
+            results[sid] = [svc.infer_session(sid, left, right,
+                                              timeout=300)
+                            for _ in range(n_frames)]
+
+        threads = [threading.Thread(target=client, args=(f"c{i}", i))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for sid in ("c0", "c1"):
+            rs = results[sid]
+            assert [r.frame_index for r in rs] == list(range(n_frames))
+            assert [r.warm for r in rs] == [False] + [True] * (n_frames - 1)
+        assert svc.sessions.active_count == 2
+        assert svc.metrics.session_frames("warm") == 2 * (n_frames - 1)
+
+
+def test_engine_keyframe_guard_reseeds_on_cap(tiny_model):
+    """A warm frame on an early-exit tier that runs to the iteration cap
+    never converged: its state is dropped (serve_session_reseeds_total)
+    and the NEXT frame cold-starts — warm-chain drift is bounded by one
+    segment.  A threshold far below any real update (1e-9 px) pins
+    every frame at the cap deterministically."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=2, sessions=True,
+            tiers=("never:0.000000001:1", "quality"),
+            default_tier="never")) as svc:
+        results = [svc.infer_session("s", left, right, timeout=300)
+                   for _ in range(4)]
+        assert [r.iters_used for r in results] == [2] * 4  # all at cap
+        # frame 0 cold; frame 1 warm (cold state trusted) but hits the
+        # cap -> reseed; frame 2 cold again; frame 3 warm; ...
+        assert [r.warm for r in results] == [False, True, False, True]
+        assert svc.metrics.session_reseeds.value == 2
+        assert "serve_session_reseeds_total 2" in \
+            svc.metrics.render_text()
+
+
+def test_engine_session_ttl_expiry_typed(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS, sessions=True,
+                                   session_ttl_s=100.0)) as svc:
+        svc.infer_session("s", left, right, timeout=300)
+        # expire deterministically: rewind the session's last-used stamp
+        svc.sessions.get("s").last_used_mono -= 101.0
+        with pytest.raises(SessionExpired) as e:
+            svc.infer_session("s", left, right, timeout=300)
+        assert e.value.reason == "expired"
+        assert svc.metrics.sessions_expired.value == 1
+
+
+def test_engine_sessions_disabled_typed(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS)) as svc:
+        assert svc.sessions is None
+        with pytest.raises(SessionsDisabled):
+            svc.infer_session("s", left, right, timeout=300)
+        with pytest.raises(SessionsDisabled):
+            svc.close_session("s")
+
+
+def test_engine_warm_families_join_prewarm_and_ready(tiny_model):
+    """Warm/state executable families are first-class warm surface: the
+    /readyz target includes them, prewarm compiles them, and their
+    persistent-cache keys never collide with the base program's (the
+    satellite fix: key includes the flow_init arity)."""
+    from raft_stereo_tpu.serving import (FAMILY_BASE, FAMILY_STATE,
+                                         FAMILY_WARM, ServeConfig,
+                                         StereoService)
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS, sessions=True,
+            warmup_shapes=((48, 64),), prewarm_on_init=False)) as svc:
+        families = {t[4] for t in svc._warm_target}
+        assert families == {FAMILY_BASE, FAMILY_STATE, FAMILY_WARM}
+        assert not svc.ready
+        svc.prewarm((48, 64))
+        assert svc.ready
+        # distinct disk-cache keys per family (warm/cold arity)
+        keys = {svc._disk_key((64, 64), 1, 0, None, fam)
+                for fam in (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)}
+        assert len(keys) == 3
+        # prewarmed programs serve immediately (no first-request compile
+        # for any family): a session's first two frames exercise state +
+        # warm
+        left, right = _pair()
+        f0 = svc.infer_session("s", left, right, timeout=300)
+        f1 = svc.infer_session("s", left, right, timeout=300)
+        assert not f0.warm and f1.warm
+
+
+def test_stateless_engine_warm_surface_unchanged(tiny_model):
+    """sessions=False keeps the round-13 warm surface: base family only
+    — no extra compiles, no extra readiness entries."""
+    from raft_stereo_tpu.serving import FAMILY_BASE, ServeConfig, \
+        StereoService
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS,
+            warmup_shapes=((48, 64),), prewarm_on_init=False)) as svc:
+        assert {t[4] for t in svc._warm_target} == {FAMILY_BASE}
+        assert len(svc._warm_target) == 1
+
+
+# -------------------------------------------------------------------- http
+@pytest.fixture()
+def http_server(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=2, iters=ITERS,
+                                    sessions=True, session_ttl_s=100.0))
+    server = StereoHTTPServer(svc, port=0).start()
+    yield server, svc
+    server.shutdown()
+    svc.close()
+
+
+def _post_stream(url, sid, left, right, path=None):
+    buf = io.BytesIO()
+    np.savez(buf, left=left, right=right)
+    req = urllib.request.Request(
+        path or f"{url}/v1/stream/{sid}", data=buf.getvalue(),
+        method="POST", headers={"Content-Type": "application/x-npz"})
+    return urllib.request.urlopen(req, timeout=300)
+
+
+def test_http_stream_protocol(http_server):
+    """The wire contract: session headers on frames, 410 on dead ids,
+    DELETE stats, 400 on a missing id, sessions_active on /healthz."""
+    server, svc = http_server
+    url = server.url
+    left, right = _pair()
+
+    with _post_stream(url, "cam1", left, right) as resp:
+        assert resp.status == 200
+        assert resp.headers["X-Session-Id"] == "cam1"
+        assert resp.headers["X-Warm"] == "0"
+        assert resp.headers["X-Frame-Index"] == "0"
+        disp = np.load(io.BytesIO(resp.read()))
+        assert disp.shape == left.shape[:2]
+    with _post_stream(url, "cam1", left, right) as resp:
+        assert resp.headers["X-Warm"] == "1"
+        assert resp.headers["X-Frame-Index"] == "1"
+        assert float(resp.headers["X-Frame-Delta"]) == pytest.approx(0.0)
+
+    # X-Session-Id header addressing on the bare path joins the session
+    buf = io.BytesIO()
+    np.savez(buf, left=left, right=right)
+    req = urllib.request.Request(
+        f"{url}/v1/stream", data=buf.getvalue(), method="POST",
+        headers={"Content-Type": "application/x-npz",
+                 "X-Session-Id": "cam1"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        assert resp.headers["X-Session-Id"] == "cam1"
+        assert resp.headers["X-Frame-Index"] == "2"
+        assert resp.headers["X-Warm"] == "1"
+
+
+def test_http_stream_errors_typed(http_server):
+    server, svc = http_server
+    url = server.url
+    left, right = _pair()
+
+    # missing session id -> 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_stream(url, None, left, right, path=f"{url}/v1/stream")
+    assert e.value.code == 400
+
+    # expired session -> typed 410
+    with _post_stream(url, "gone", left, right):
+        pass
+    svc.sessions.get("gone").last_used_mono -= 101.0
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_stream(url, "gone", left, right)
+    assert e.value.code == 410
+    body = json.loads(e.value.read())
+    assert body["error"] == "session_expired"
+    assert body["reason"] == "expired"
+
+    # DELETE: stats, then 410; unknown id -> 404
+    with _post_stream(url, "cam2", left, right):
+        pass
+    req = urllib.request.Request(f"{url}/v1/stream/cam2", method="DELETE")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        stats = json.loads(resp.read())
+    assert stats["status"] == "closed" and stats["frames"] == 1
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(
+            urllib.request.Request(f"{url}/v1/stream/cam2",
+                                   method="DELETE"), timeout=60)
+    assert e.value.code == 410
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(
+            urllib.request.Request(f"{url}/v1/stream/nope",
+                                   method="DELETE"), timeout=60)
+    assert e.value.code == 404
+
+    # healthz reports live sessions ("gone" expired, "cam2" closed -> 0)
+    with urllib.request.urlopen(f"{url}/healthz", timeout=60) as resp:
+        health = json.loads(resp.read())
+    assert health["sessions_active"] == 0
+
+
+def test_http_sessions_disabled_400(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=1, batch_sizes=(1,),
+                                    iters=ITERS))
+    server = StereoHTTPServer(svc, port=0).start()
+    try:
+        left, right = _pair()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_stream(server.url, "cam", left, right)
+        assert e.value.code == 400
+        assert json.loads(e.value.read())["error"] == "sessions_disabled"
+    finally:
+        server.shutdown()
+        svc.close()
