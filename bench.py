@@ -125,10 +125,20 @@ def tier_latency_split(cfg, variables, img1, img2, fixed_s: float) -> list:
         t_cfg = tier.apply(cfg)
         t_model = RAFTStereo(t_cfg)
         adaptive = t_cfg.exit_threshold_px > 0
-        secs = _seconds_per_forward(t_model, variables, img1, img2,
+        t_vars = variables
+        if t_cfg.quant != "off":
+            # The chained bench applies the model directly (not through
+            # make_forward's int8-tree program), so feed the int8
+            # ROUND-TRIPPED weights: the math matches the serving turbo
+            # tier exactly; the HBM-residency half of the win is what
+            # bench_serve.py's tier sweep measures through the engine.
+            from raft_stereo_tpu.quant import (dequantize_variables,
+                                               quantize_variables)
+            t_vars = dequantize_variables(quantize_variables(variables))
+        secs = _seconds_per_forward(t_model, t_vars, img1, img2,
                                     BENCH_ITERS)
         if adaptive:   # one un-chained apply fetches the trip count
-            out = t_model.apply(variables, img1, img2, iters=BENCH_ITERS,
+            out = t_model.apply(t_vars, img1, img2, iters=BENCH_ITERS,
                                 test_mode=True)
             iters_used = int(out[2])
         else:
@@ -137,6 +147,7 @@ def tier_latency_split(cfg, variables, img1, img2, fixed_s: float) -> list:
             "tier": tier.name,
             "exit_threshold_px": tier.exit_threshold_px,
             "min_iters": tier.min_iters,
+            "quant": tier.quant,
             "per_image_ms": round(secs * 1e3, 3),
             "vs_fixed": round(secs / fixed_s, 3),
             "iters_used": iters_used,

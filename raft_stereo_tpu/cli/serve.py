@@ -81,6 +81,9 @@ def build_service(args):
         session_ttl_s=args.session_ttl_s,
         session_capacity=args.session_capacity,
         scene_cut_threshold=args.scene_cut_threshold,
+        session_ctx_cache=args.session_ctx_cache,
+        ctx_cache_threshold=args.ctx_cache_threshold,
+        quant_scales_path=args.quant_scales,
         warmup_shapes=tuple(args.warmup_shape or ()),
         prewarm_on_init=False)
     return StereoService(cfg, variables, serve_cfg)
@@ -211,9 +214,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "names (interactive: exit once the mean "
                         "|Δdisparity| update < 0.05 px, min 2 iters; "
                         "balanced: < 0.01 px, min 3; quality: the fixed-"
-                        "depth reference program) and/or inline "
-                        "'name:threshold_px[:min_iters]' specs.  Each "
-                        "tier compiles its own bucket executables "
+                        "depth reference program; turbo: interactive's "
+                        "exit knobs on the post-training int8 path — "
+                        "quantized encoder weights + int8 correlation "
+                        "pyramid, docs/architecture.md §Quantization) "
+                        "and/or inline "
+                        "'name:threshold_px[:min_iters[:quant]]' specs. "
+                        "Each tier compiles its own bucket executables "
                         "(prewarm covers all of them) and requests pick "
                         "one via ?tier= or X-Tier; responses carry "
                         "X-Iters-Used.  Empty string disables tiers "
@@ -349,6 +356,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "this (0..255) cold-starts instead of warm-"
                         "starting from a stale disparity; <= 0 disables "
                         "the check")
+    p.add_argument("--session_ctx_cache", action="store_true",
+                   help="per-session CONTEXT-feature cache (needs "
+                        "--sessions): streams whose inter-frame delta "
+                        "stays tiny reuse the session's cnet context "
+                        "bundle instead of re-encoding it every frame "
+                        "(X-Ctx-Cached response header; invalidated by "
+                        "scene cuts and the keyframe guard).  "
+                        "Unsupported with shared_backbone "
+                        "architectures")
+    p.add_argument("--ctx_cache_threshold", type=float, default=2.0,
+                   help="mean inter-frame |delta-intensity| (0..255) at "
+                        "or below which a warm frame may reuse the "
+                        "cached context — the static-scene gate, far "
+                        "below the scene-cut threshold by design")
+    p.add_argument("--quant_scales", default=None,
+                   help="checkpoint-adjacent int8 calibration scale file "
+                        "(quant/calibrate.py): int8 tiers (e.g. "
+                        "'turbo') compile with the calibrated "
+                        "percentile-clipped correlation scales instead "
+                        "of dynamic in-graph max-abs scales")
     p.add_argument("--chaos", default=None,
                    help="FAULT INJECTION (testing only): comma key=value "
                         "spec, e.g. 'crash=0.1,seed=7' for a 10%% "

@@ -177,6 +177,32 @@ class RaftStereoConfig:
     exit_min_iters: int = 1
     # Hard cap on the loop depth; None = the caller's ``iters`` argument.
     exit_max_iters: Optional[int] = None
+    # --- Post-training int8 inference tier (quant/, inference only) -----
+    # "int8": encoder conv weights ship int8 with per-output-channel
+    # scales and dequantize in-register inside the jitted program
+    # (quant/core.py; params on disk stay fp32 — the runner/engine
+    # quantize at load), and the correlation pyramid stores int8 with
+    # per-level scales read by the extended Pallas lookup kernels
+    # (models/corr.py).  The memory-bound halves of the per-frame cost
+    # (COST_REPORT_r10.json roofline) move 1/4 (vs fp32) or 1/2 (vs
+    # bf16) of the bytes.  "off" (default) compiles the EXACT pre-quant
+    # program — bitwise-identical, pinned by tests/test_quant.py.
+    # Accuracy is gated by the measured in-distribution drift
+    # (tools/quant_drift.py -> QUANT_DRIFT_r15.json), the BF16_DRIFT
+    # methodology extended down.  Inference-only: the training CLIs
+    # never set it, and the quantized corr path runs under
+    # stop_gradient.
+    quant: str = "off"
+    # Also store the correlation pyramid int8 when quant != "off"
+    # (False: weights-only quantization — the ablation knob the drift
+    # tool measures both sides of).
+    quant_corr: bool = True
+    # Calibrated per-level int8 scales for the correlation pyramid
+    # (quant/calibrate.py corr_scales; percentile-clipped on
+    # in-distribution pairs).  None = dynamic per-level max-abs scales
+    # computed in-graph (shape-generic, no file dependency, one extra
+    # reduction per level per forward).
+    quant_corr_scales: Optional[Tuple[float, ...]] = None
 
     def __post_init__(self):
         if self.context_dims is None:
@@ -245,6 +271,35 @@ class RaftStereoConfig:
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "
                 f"volume and is incompatible with corr_backend='alt' (which "
                 f"builds no volume) — use 'reg' or 'reg_fused'")
+        if self.quant not in ("off", "int8"):
+            raise ValueError(
+                f"quant={self.quant!r} not in ('off', 'int8')")
+        if self.quant != "off":
+            for field, why in (
+                    ("rows_shards", self.rows_shards > 1),
+                    ("rows_gru", self.rows_gru),
+                    ("corr_w2_shards", self.corr_w2_shards > 1),
+                    ("banded_encoder", self.banded_encoder)):
+                if why:
+                    raise ValueError(
+                        f"quant={self.quant!r} is unsupported with "
+                        f"{field}: the sharded/banded executors run "
+                        f"their own full-precision paths — quantize the "
+                        f"single-chip serving configs")
+        if self.quant_corr_scales is not None:
+            object.__setattr__(self, "quant_corr_scales",
+                               tuple(float(s)
+                                     for s in self.quant_corr_scales))
+            if len(self.quant_corr_scales) != self.corr_levels:
+                raise ValueError(
+                    f"quant_corr_scales has "
+                    f"{len(self.quant_corr_scales)} entries for "
+                    f"corr_levels={self.corr_levels} — recalibrate "
+                    f"(quant/calibrate.py) for this architecture")
+            if any(s <= 0 for s in self.quant_corr_scales):
+                raise ValueError(
+                    f"quant_corr_scales={self.quant_corr_scales} must "
+                    f"be positive")
 
     # ------------------------------------------------------------------ sizes
     @property
@@ -302,23 +357,31 @@ class RaftStereoConfig:
 class RequestTier:
     """A named accuracy/latency point on the early-exit knob.
 
-    A tier is just a preset of (exit_threshold_px, min_iters): the serving
-    engine compiles one executable family per tier
+    A tier is a preset of (exit_threshold_px, min_iters, quant): the
+    serving engine compiles one executable family per tier
     (serving/engine.py), the HTTP front door selects one per request, and
     the CLIs accept the raw knobs directly.  ``exit_threshold_px <= 0``
     means the tier runs the fixed-depth scan program (full quality,
-    bitwise-identical to the pre-early-exit path)."""
+    bitwise-identical to the pre-early-exit path).  ``quant="int8"``
+    additionally runs the tier on the post-training int8 path
+    (``RaftStereoConfig.quant``; the engine feeds such tiers the
+    quantized variable tree and keys their executables separately in
+    both the compile-cost registry and the persistent disk cache)."""
 
     name: str
     exit_threshold_px: float
     min_iters: int = 1
+    quant: str = "off"
 
     def apply(self, cfg: RaftStereoConfig) -> RaftStereoConfig:
         """The model config this tier's requests compile: the base
-        architecture with the early-exit knobs swapped in."""
+        architecture with the early-exit + quantization knobs swapped
+        in.  A tier that changes nothing maps back to the base config
+        exactly, which is how the engine detects shareable executables."""
         return dataclasses.replace(
             cfg, exit_threshold_px=self.exit_threshold_px,
-            exit_min_iters=self.min_iters, exit_max_iters=None)
+            exit_min_iters=self.min_iters, exit_max_iters=None,
+            quant=self.quant)
 
 
 # Threshold units are px of mean |Δdisparity| per iteration at feature
@@ -327,20 +390,28 @@ class RequestTier:
 # tools/early_exit_report.py -> EARLY_EXIT_r12.json): "interactive" trades
 # ~hundredths of a px of EPE for the biggest latency cut, "balanced"
 # stops once updates are metric-noise, "quality" is the reference
-# fixed-depth program.
+# fixed-depth program.  "turbo" is the int8 tier: interactive's exit
+# knobs on the post-training int8 path (quantized encoder weights + int8
+# correlation pyramid) — the bottom rung of the brownout cost ladder,
+# gated by the measured drift (tools/quant_drift.py ->
+# QUANT_DRIFT_r15.json).
 REQUEST_TIERS: Dict[str, RequestTier] = {
     "interactive": RequestTier("interactive", exit_threshold_px=0.05,
                                min_iters=2),
     "balanced": RequestTier("balanced", exit_threshold_px=0.01,
                             min_iters=3),
     "quality": RequestTier("quality", exit_threshold_px=0.0, min_iters=1),
+    "turbo": RequestTier("turbo", exit_threshold_px=0.05, min_iters=2,
+                         quant="int8"),
 }
 
 
 def parse_tier(spec: Union[str, RequestTier]) -> RequestTier:
-    """A tier from a preset name or an inline ``name:threshold[:min]``
-    spec — ``"interactive"`` uses the preset, ``"fast:0.1:2"`` defines an
-    ad-hoc tier (bench/smoke harnesses pin exact knobs this way)."""
+    """A tier from a preset name or an inline
+    ``name:threshold[:min[:quant]]`` spec — ``"interactive"`` uses the
+    preset, ``"fast:0.1:2"`` defines an ad-hoc tier, and
+    ``"fast8:0.1:2:int8"`` puts it on the int8 path (bench/smoke
+    harnesses pin exact knobs this way)."""
     if isinstance(spec, RequestTier):
         return spec
     parts = str(spec).split(":")
@@ -350,19 +421,23 @@ def parse_tier(spec: Union[str, RequestTier]) -> RequestTier:
             raise ValueError(
                 f"unknown tier {parts[0]!r}: use one of "
                 f"{sorted(REQUEST_TIERS)} or an inline "
-                f"'name:threshold_px[:min_iters]' spec")
+                f"'name:threshold_px[:min_iters[:quant]]' spec")
         return tier
-    if len(parts) not in (2, 3) or not parts[0]:
+    if len(parts) not in (2, 3, 4) or not parts[0]:
         raise ValueError(f"tier spec {spec!r}: expected "
-                         f"'name:threshold_px[:min_iters]'")
+                         f"'name:threshold_px[:min_iters[:quant]]'")
     try:
         threshold = float(parts[1])
-        min_iters = int(parts[2]) if len(parts) == 3 else 1
+        min_iters = int(parts[2]) if len(parts) >= 3 else 1
     except ValueError as e:
         raise ValueError(f"tier spec {spec!r}: expected "
-                         f"'name:threshold_px[:min_iters]'") from e
+                         f"'name:threshold_px[:min_iters[:quant]]'") from e
+    quant = parts[3] if len(parts) == 4 else "off"
+    if quant not in ("off", "int8"):
+        raise ValueError(f"tier spec {spec!r}: quant {quant!r} not in "
+                         f"('off', 'int8')")
     return RequestTier(parts[0], exit_threshold_px=threshold,
-                       min_iters=min_iters)
+                       min_iters=min_iters, quant=quant)
 
 
 @dataclasses.dataclass(frozen=True)

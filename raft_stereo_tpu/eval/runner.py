@@ -71,7 +71,8 @@ def early_exit_enabled(config: RaftStereoConfig) -> bool:
 
 def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
                  donate_images: bool = True, warm_start: bool = False,
-                 return_state: bool = False):
+                 return_state: bool = False,
+                 ctx: Optional[str] = None):
     """The one jitted inference program both the solo runner and the
     serving engine compile, per (padded shape, batch): cast -> forward ->
     optional half-precision fetch cast.  Built here so the two paths share
@@ -108,17 +109,50 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
       ``flow_init`` is donated alongside the images when
       ``donate_images`` — it is the same shape/dtype as the
       ``flow_low`` output, so XLA can alias the state round-trip.
+    * ``ctx`` ("save" | "reuse"; streaming only, implies the streaming
+      signature) — the per-session CONTEXT cache (round 15): "save"
+      appends the frame's context bundle (initial GRU hidden states +
+      context biases, models/raft_stereo.py ``return_ctx``) as the LAST
+      output; "reuse" appends the bundle as the LAST traced input and
+      SKIPS the context encoder entirely (``ctx_init``) — the program a
+      static-camera stream runs once the inter-frame delta proves the
+      scene unchanged.  The bundle is a pytree and rides jit like any
+      other argument; it is never donated (the session re-feeds it
+      frame after frame from its host copy).
+
+    With ``model.config.quant == "int8"`` every variant expects the
+    QUANTIZED variable tree (quant/core.quantize_variables) and
+    dequantizes it in-register at the top of the program — int8 is what
+    uploads and resides; ``quant="off"`` builds the exact pre-quant
+    jaxpr (no dequant ops are traced).
     """
     adaptive = early_exit_enabled(model.config)
+    quantized = model.config.quant != "off"
 
-    if warm_start or return_state:
-        def fwd_stream(variables, images1, images2, *flow_init):
+    def prepare(variables):
+        if quantized:
+            from raft_stereo_tpu.quant.core import dequantize_variables
+            return dequantize_variables(variables)
+        return variables
+
+    if warm_start or return_state or ctx is not None:
+        if ctx not in (None, "save", "reuse"):
+            raise ValueError(f"ctx={ctx!r}: use None, 'save', or 'reuse'")
+
+        def fwd_stream(variables, images1, images2, *extra):
             img1 = images1.astype(jnp.float32)
             img2 = images2.astype(jnp.float32)
+            pos = 0
+            flow_init = None
+            if warm_start:
+                flow_init = extra[pos].astype(jnp.float32)
+                pos += 1
+            ctx_init = extra[pos] if ctx == "reuse" else None
             out = model.apply(
-                variables, img1, img2, iters=iters, test_mode=True,
-                flow_init=(flow_init[0].astype(jnp.float32)
-                           if warm_start else None))
+                variables if not quantized else prepare(variables),
+                img1, img2, iters=iters, test_mode=True,
+                flow_init=flow_init, ctx_init=ctx_init,
+                return_ctx=(ctx == "save"))
             flow_up = out[1]
             if fetch_dtype is not None:
                 flow_up = flow_up.astype(fetch_dtype)
@@ -126,7 +160,11 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
             # next frame's init, and a half-precision state would compound
             # rounding frame over frame.
             ret = (flow_up, out[0].astype(jnp.float32))
-            return ret + ((out[2],) if adaptive else ())
+            if adaptive:
+                ret = ret + (out[2],)
+            if ctx == "save":
+                ret = ret + (out[-1],)
+            return ret
 
         donate = ((1, 2, 3) if warm_start else (1, 2)) \
             if donate_images else ()
@@ -135,8 +173,9 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
         img1 = images1.astype(jnp.float32)
         img2 = images2.astype(jnp.float32)
-        out = model.apply(variables, img1, img2, iters=iters,
-                          test_mode=True)
+        out = model.apply(variables if not quantized
+                          else prepare(variables),
+                          img1, img2, iters=iters, test_mode=True)
         flow_up = out[1]
         if fetch_dtype is not None:
             flow_up = flow_up.astype(fetch_dtype)
@@ -181,7 +220,8 @@ class InferenceRunner:
                  cost_registry=None, cost_site: str = "eval",
                  donate_images: bool = True,
                  exit_threshold_px: Optional[float] = None,
-                 exit_min_iters: Optional[int] = None):
+                 exit_min_iters: Optional[int] = None,
+                 quant: Optional[str] = None):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -218,7 +258,12 @@ class InferenceRunner:
         (config.py), ``iters`` becomes the depth CAP, and every call
         records its actual trip count (``last_iters_used`` /
         ``iters_used_mean()``).  The default keeps the fixed-depth scan
-        program bitwise-unchanged."""
+        program bitwise-unchanged.
+        ``quant`` (None = the config's own knob): "int8" runs this
+        runner on the post-training int8 path — the given fp32
+        ``variables`` are quantized HERE at construction
+        (quant/core.quantize_variables; checkpoints on disk stay fp32)
+        and every compiled program dequantizes in-register."""
         if shape_bucket is not None and shape_bucket % divis_by:
             raise ValueError(f"shape_bucket={shape_bucket} must be a "
                              f"multiple of the model's /{divis_by} "
@@ -230,7 +275,8 @@ class InferenceRunner:
         # against their own (eval.validate.make_validation_fn re-creates the
         # runner on mismatch); the guard's flip lives in effective_config.
         self.config = config
-        if exit_threshold_px is not None or exit_min_iters is not None:
+        if (exit_threshold_px is not None or exit_min_iters is not None
+                or quant is not None):
             config = dataclasses.replace(
                 config,
                 exit_threshold_px=(config.exit_threshold_px
@@ -238,10 +284,19 @@ class InferenceRunner:
                                    else exit_threshold_px),
                 exit_min_iters=(config.exit_min_iters
                                 if exit_min_iters is None
-                                else exit_min_iters))
+                                else exit_min_iters),
+                quant=config.quant if quant is None else quant)
         self.effective_config = effective_inference_config(
             config, iters, corr_fp32_auto)
         self.early_exit = early_exit_enabled(self.effective_config)
+        if self.effective_config.quant != "off":
+            # Host-side, once per runner: int8 weights are what upload
+            # and reside on device; disk checkpoints stay fp32.
+            from raft_stereo_tpu.quant.core import (quantize_variables,
+                                                    tree_is_quantized)
+            if not tree_is_quantized(variables):
+                variables = quantize_variables(variables,
+                                               self.effective_config)
         # Per-call trip-count accounting (early exit only): the CLIs print
         # it and tools/early_exit_report.py averages it per validator.
         self.last_iters_used: Optional[int] = None

@@ -6,16 +6,24 @@ Three kernels share one contract (docs/architecture.md §Kernels): an
 CPU suite runs the kernel code path through the HLO interpreter, and a
 transparent fallback to the pure-XLA path when unavailable.
 
+The ``*_q`` variants are the round-15 int8 entries: same kernels reading
+int8 volumes/features with the in-kernel fp32 upcast acting as the
+in-register dequant (callers apply the scales — docs/architecture.md
+§Quantization).  Forward-only by design; the fp custom-VJP entries stay
+the training path.
+
 Callers import from HERE; the submodules' underscored helpers are
 implementation detail.
 """
 
 from raft_stereo_tpu.kernels.corr_alt import (alt_fused_available,
                                               alt_fused_fits,
-                                              alt_lookup_fused)
+                                              alt_lookup_fused,
+                                              alt_lookup_fused_q)
 from raft_stereo_tpu.kernels.corr_lookup import (fused_lookup_available,
                                                  interpret_enabled,
-                                                 lookup_pyramid_fused)
+                                                 lookup_pyramid_fused,
+                                                 lookup_pyramid_fused_q)
 from raft_stereo_tpu.kernels.gru_fused import (gru_fused_available,
                                                gru_fused_row_block,
                                                gru_fused_should_use,
@@ -25,6 +33,7 @@ __all__ = [
     "alt_fused_available",
     "alt_fused_fits",
     "alt_lookup_fused",
+    "alt_lookup_fused_q",
     "fused_lookup_available",
     "gru_fused_available",
     "gru_fused_row_block",
@@ -32,4 +41,5 @@ __all__ = [
     "gru_gates_fused",
     "interpret_enabled",
     "lookup_pyramid_fused",
+    "lookup_pyramid_fused_q",
 ]

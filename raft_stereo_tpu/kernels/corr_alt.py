@@ -157,7 +157,12 @@ def _fwd_row_bytes(w1_blk, w2, d, itemsize, radius):
             + w1_blk * w2 * fp32)               # product intermediate
 
 
-def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
+def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d,
+                out_dtype=None):
+    # ``out_dtype`` (default: f1's own dtype) exists for the int8
+    # feature path: int8 features correlate to fp values (the in-kernel
+    # fp32 upcast is the in-register dequant modulo the feature scales
+    # the caller applies), so the output must not round through int8.
     rows, w1, d = f1.shape
     w2 = f2.shape[1]
     k = 2 * radius + 1
@@ -179,7 +184,8 @@ def _launch_fwd(f1, f2, coords, radius, scale, inv_sqrt_d):
         ],
         out_specs=pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, w1, k), f1.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k),
+                                       out_dtype or f1.dtype),
         interpret=_interpret(),
     )(f1, f2, coords[..., None])
 
@@ -389,4 +395,78 @@ def alt_lookup_fused(fmap1: jnp.ndarray, fmap2_pyramid: List[jnp.ndarray],
 
     outs = [_alt_level(fmap1, f2, coords, radius, 1.0 / (2 ** i))
             for i, f2 in enumerate(fmap2_pyramid)]
+    return jnp.concatenate(outs, axis=-1)
+
+
+# ----------------------------------------------------- int8 feature entry
+def _launch_fwd_multi_q(f1, f2cat, coords, radius: int, offsets, widths,
+                        inv_sqrt_d: float, out_dtype):
+    """Forward-only single-launch all-levels lookup over int8 features:
+    the ``_fwd_multi_kernel`` body unchanged (its fp32 upcast is the
+    in-register dequant), only the output dtype overridden."""
+    rows, w1, d = f1.shape
+    wcat = f2cat.shape[1]
+    k = (2 * radius + 1) * len(offsets)
+    grid = (pl.cdiv(rows, ROW_BLK), pl.cdiv(w1, W1_BLK))
+    return pl.pallas_call(
+        functools.partial(_fwd_multi_kernel, radius=radius,
+                          offsets=offsets, widths=widths,
+                          inv_sqrt_d=inv_sqrt_d,
+                          precision=_precision_for(f1.dtype)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLK, W1_BLK, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, wcat, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLK, W1_BLK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLK, W1_BLK, k), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k), out_dtype),
+        interpret=_interpret(),
+    )(f1, f2cat, coords)
+
+
+def alt_lookup_fused_q(fmap1_q: jnp.ndarray,
+                       fmap2_pyramid_q: List[jnp.ndarray],
+                       coords: jnp.ndarray, radius: int,
+                       out_dtype) -> jnp.ndarray:
+    """The no-volume lookup over INT8 feature maps (round-15 turbo
+    tier): each tile's volume slice is computed on the MXU from int8
+    features upcast in-register — the features move 1/4 (vs fp32) or
+    1/2 (vs bf16) of the HBM bytes per iteration.  The RAW integer
+    correlations come back in ``out_dtype``; the caller applies the
+    combined feature scales ``s1 * s2_level`` per level
+    (models/corr.py) — the dot product is bilinear, so the scales
+    factor out exactly.
+
+    Forward-only (inference tier, under ``stop_gradient``); same
+    launch selection and scoped-VMEM gating as ``alt_lookup_fused``
+    with the int8 itemsize shrinking the estimate."""
+    d = fmap1_q.shape[-1]
+    b, h, w1, _ = fmap1_q.shape
+    w2s = [f2.shape[2] for f2 in fmap2_pyramid_q]
+    rows = b * h
+    inv_sqrt_d = 1.0 / math.sqrt(d)
+    if (_multi_alt_scoped_bytes(w2s, d, fmap1_q.dtype.itemsize, radius)
+            <= _MOSAIC_SCOPED_VMEM):
+        offsets = tuple(int(sum(w2s[:i])) for i in range(len(w2s)))
+        widths = tuple(int(w) for w in w2s)
+        f2cat = jnp.concatenate(fmap2_pyramid_q, axis=2)
+        out = _launch_fwd_multi_q(
+            fmap1_q.reshape(rows, w1, d),
+            f2cat.reshape(rows, sum(w2s), d),
+            coords.reshape(rows, w1), radius, offsets, widths,
+            inv_sqrt_d, out_dtype)
+        return out.reshape(b, h, w1, -1)
+    outs = []
+    for i, f2 in enumerate(fmap2_pyramid_q):
+        out = _launch_fwd(fmap1_q.reshape(rows, w1, d),
+                          f2.reshape(rows, f2.shape[2], d),
+                          coords.reshape(rows, w1), radius,
+                          1.0 / (2 ** i), inv_sqrt_d,
+                          out_dtype=out_dtype)
+        outs.append(out.reshape(b, h, w1, -1))
     return jnp.concatenate(outs, axis=-1)

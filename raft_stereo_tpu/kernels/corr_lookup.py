@@ -144,7 +144,12 @@ def _bwd_kernel(coords_ref, g_ref, dvol_ref, *, radius: int, scale: float):
 # on the last two block dims keeps holding when the row block shrinks below
 # 8 for VMEM (large W2).
 def _launch_fwd(vol: jnp.ndarray, coords: jnp.ndarray, radius: int,
-                scale: float) -> jnp.ndarray:
+                scale: float, out_dtype=None) -> jnp.ndarray:
+    # ``out_dtype`` (default: the volume's own dtype) exists for the
+    # int8 pyramid path: an int8 volume samples to fp values (the
+    # in-kernel fp32 upcast IS the in-register dequant modulo the
+    # per-level scale the caller applies), so the output must not
+    # round-trip through int8.
     rows, w1, w2 = vol.shape
     k = 2 * radius + 1
     rb = row_blk_for(_lookup_row_bytes(w2, radius, vol.dtype.itemsize))
@@ -160,7 +165,8 @@ def _launch_fwd(vol: jnp.ndarray, coords: jnp.ndarray, radius: int,
         ],
         out_specs=pl.BlockSpec((rb, W1_BLK, k), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, w1, k), vol.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, w1, k),
+                                       out_dtype or vol.dtype),
         interpret=_interpret(),
     )(vol, coords[..., None])
 
@@ -248,7 +254,7 @@ def _bwd_kernel_multi(coords_ref, g_ref, *dvol_refs, radius: int,
         dvol_refs[i][:] = dvol.astype(dvol_refs[i].dtype)
 
 
-def _launch_fwd_multi(vols, coords, radius: int):
+def _launch_fwd_multi(vols, coords, radius: int, out_dtype=None):
     rows, w1 = coords.shape
     levels = len(vols)
     k = 2 * radius + 1
@@ -265,7 +271,7 @@ def _launch_fwd_multi(vols, coords, radius: int):
                                lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, w1, levels * k),
-                                       vols[0].dtype),
+                                       out_dtype or vols[0].dtype),
         interpret=_interpret(),
     )(*vols, coords[..., None])
 
@@ -352,4 +358,40 @@ def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
         return _sample_pyramid(tuple(pyramid), coords, radius)
     outs = [_sample_level(vol, coords, radius, 1.0 / (2 ** i))
             for i, vol in enumerate(pyramid)]
+    return jnp.concatenate(outs, axis=-1)
+
+
+# ------------------------------------------------------ int8 pyramid entry
+def lookup_pyramid_fused_q(pyramid: List[jnp.ndarray],
+                           coords: jnp.ndarray, radius: int,
+                           out_dtype) -> jnp.ndarray:
+    """Fused window lookup over an INT8 pyramid (round-15 turbo tier):
+    the kernels read the int8 volume tiles from HBM — 1/4 (vs fp32) or
+    1/2 (vs bf16) of the bytes the memory-bound lookup moves
+    (COST_REPORT_r10.json roofline) — and the in-kernel fp32 upcast of
+    each tile is the in-register dequant.  The caller applies the
+    per-level scales to the RAW sampled output (models/corr.py): hat
+    sampling is linear, so ``scale * sample(q)`` equals
+    ``sample(scale * q)`` exactly.
+
+    Forward-only by design — the int8 tier is inference-only and runs
+    under ``stop_gradient`` (the fp custom-VJP entries above stay the
+    training path), so no int8 cotangent program exists to get wrong.
+    Same multi-vs-per-level launch selection and VMEM gating as
+    ``lookup_pyramid_fused`` (itemsize=1 shrinks the working set, so
+    the single-launch path holds to larger shapes)."""
+    b, h, w1, _ = pyramid[0].shape
+    w2s = [v.shape[-1] for v in pyramid]
+    if (len(pyramid) > 1 and _multi_working_set(
+            w2s, radius, pyramid[0].dtype.itemsize) <= VMEM_BUDGET):
+        out = _launch_fwd_multi(
+            [v.reshape(b * h, w1, v.shape[-1]) for v in pyramid],
+            coords.reshape(b * h, w1), radius, out_dtype=out_dtype)
+        return out.reshape(b, h, w1, -1)
+    outs = []
+    for i, vol in enumerate(pyramid):
+        out = _launch_fwd(vol.reshape(b * h, w1, vol.shape[-1]),
+                          coords.reshape(b * h, w1), radius,
+                          1.0 / (2 ** i), out_dtype=out_dtype)
+        outs.append(out.reshape(b, h, w1, -1))
     return jnp.concatenate(outs, axis=-1)

@@ -30,7 +30,7 @@ sampler/sampler_kernel.cu:126).
 from __future__ import annotations
 
 import math
-from typing import Callable, List
+from typing import Callable, List, Tuple
 
 import jax.lax as lax
 import jax.numpy as jnp
@@ -40,6 +40,55 @@ from raft_stereo_tpu.ops.sampler import (linear_sampler_1d,
                                          linear_sampler_1d_features)
 
 CorrFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+# ------------------------------------------------------------ int8 pyramid
+def corr_quant_enabled(cfg: RaftStereoConfig) -> bool:
+    """Whether this config stores the correlation pyramid int8
+    (round-15 turbo tier): the lookup is memory-bound
+    (COST_REPORT_r10.json roofline), so the int8 volume moves 1/4 (vs
+    fp32) or 1/2 (vs bf16) of the bytes per iteration."""
+    return cfg.quant == "int8" and cfg.quant_corr
+
+
+def quantize_pyramid(pyramid: List[jnp.ndarray], cfg: RaftStereoConfig
+                     ) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
+    """Per-level symmetric int8 quantization of the (fp) pyramid:
+    ``(int8 levels, per-level fp32 scales)``.  Scales are the calibrated
+    percentile-clipped constants when the config carries them
+    (``quant_corr_scales``, quant/calibrate.py) or per-level max-abs
+    reductions computed in-graph otherwise.  Inference-only: the volume
+    is detached first (the int8 tier never trains — round() has no
+    useful gradient and the fused q kernels are forward-only)."""
+    from raft_stereo_tpu.quant.core import dynamic_scale, quantize_symmetric
+
+    pyramid = [lax.stop_gradient(v) for v in pyramid]
+    if cfg.quant_corr_scales is not None:
+        scales = [jnp.float32(s) for s in cfg.quant_corr_scales]
+    else:
+        scales = [dynamic_scale(v) for v in pyramid]
+    return ([quantize_symmetric(v, s) for v, s in zip(pyramid, scales)],
+            scales)
+
+
+def _tap_scale_vector(scales: List[jnp.ndarray], radius: int
+                      ) -> jnp.ndarray:
+    """The per-channel dequant vector of a level-major lookup output:
+    level i's scale repeated over its 2r+1 taps.  Hat sampling is linear
+    in the volume, so ``scale * sample(q) == sample(scale * q)``
+    exactly — the scale multiply after the kernel IS the dequant."""
+    return jnp.repeat(jnp.stack([s.astype(jnp.float32) for s in scales]),
+                      2 * radius + 1)
+
+
+def _dequantize_levels(pyramid_q: List[jnp.ndarray],
+                       scales: List[jnp.ndarray], dtype
+                       ) -> List[jnp.ndarray]:
+    """XLA-fallback dequant (CPU / non-Pallas backends): same int8
+    grid, same scales — bit-level the same QUANTIZATION as the kernel
+    path, only the sample-then-scale order differs (both linear)."""
+    return [(q.astype(jnp.float32) * s).astype(dtype)
+            for q, s in zip(pyramid_q, scales)]
 
 
 def build_corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
@@ -98,6 +147,12 @@ def make_corr_fn_reg(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
     fmap2 = fmap2.astype(jnp.float32)
     pyramid = build_corr_pyramid(build_corr_volume(fmap1, fmap2),
                                  cfg.corr_levels)
+    if corr_quant_enabled(cfg):
+        # The pure-XLA int8 reference: same int8 grid and scales as the
+        # fused kernel path, dequantized before the XLA sampler — the
+        # numerics the kernel parity tests compare against.
+        pyramid_q, scales = quantize_pyramid(pyramid, cfg)
+        pyramid = _dequantize_levels(pyramid_q, scales, jnp.float32)
 
     def corr_fn(coords):
         return lookup_pyramid_xla(pyramid, coords, cfg.corr_radius)
@@ -130,7 +185,43 @@ def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
     for _ in range(cfg.corr_levels - 1):
         fmap2_pyramid.append(pool_axis(fmap2_pyramid[-1], axis=2))
 
-    if use_fused:
+    if corr_quant_enabled(cfg):
+        # The no-volume backend has no pyramid to store — its bytes are
+        # the FEATURE maps re-read every iteration, so those quantize
+        # instead: per-tensor symmetric int8 (dynamic in-graph scales —
+        # feature ranges are not what quant_corr_scales calibrates), and
+        # the combined scale s1*s2_level factors out of the bilinear dot
+        # exactly.  The fused q kernel upcasts in-register; the XLA
+        # fallback dequantizes then runs the reference path.
+        from raft_stereo_tpu.quant.core import (dynamic_scale,
+                                                quantize_symmetric)
+
+        f1_det = lax.stop_gradient(fmap1)
+        s1 = dynamic_scale(f1_det)
+        f1_q = quantize_symmetric(f1_det, s1)
+        f2_qs, s2s = [], []
+        for f2 in fmap2_pyramid:
+            f2_det = lax.stop_gradient(f2)
+            s2 = dynamic_scale(f2_det)
+            f2_qs.append(quantize_symmetric(f2_det, s2))
+            s2s.append(s2)
+        if use_fused:
+            from raft_stereo_tpu.kernels.corr_alt import alt_lookup_fused_q
+
+            compute_dtype = fmap1.dtype
+            scale_vec = _tap_scale_vector(
+                [s1 * s2 for s2 in s2s], cfg.corr_radius)
+
+            def corr_fn(coords):
+                raw = alt_lookup_fused_q(f1_q, f2_qs, coords,
+                                         cfg.corr_radius,
+                                         out_dtype=jnp.float32)
+                return (raw * scale_vec).astype(compute_dtype)
+            return corr_fn
+        fmap1 = (f1_q.astype(jnp.float32) * s1)
+        fmap2_pyramid = [(q.astype(jnp.float32) * s)
+                         for q, s in zip(f2_qs, s2s)]
+    elif use_fused:
         def corr_fn(coords):
             return alt_lookup_fused(fmap1, fmap2_pyramid, coords,
                                     cfg.corr_radius)
@@ -154,16 +245,43 @@ def make_corr_fn_reg_fused(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
     """Pallas-fused pyramid lookup (≙ reference sampler/ CUDA extension).
 
     Falls back to the XLA lookup when Pallas is unavailable (e.g. CPU tests).
-    Keeps the compute dtype of the inputs (bf16-safe)."""
+    Keeps the compute dtype of the inputs (bf16-safe).  With
+    ``cfg.quant == "int8"`` the pyramid is stored int8 with per-level
+    scales and the kernels dequantize in-register
+    (kernels/corr_lookup.lookup_pyramid_fused_q); the XLA fallback
+    dequantizes the same int8 grid before sampling, so the tier's
+    numerics are backend-independent up to float associativity."""
     from raft_stereo_tpu.kernels.corr_lookup import (
-        fused_lookup_available, lookup_pyramid_fused)
+        fused_lookup_available, lookup_pyramid_fused,
+        lookup_pyramid_fused_q)
 
     compute_dtype = fmap1.dtype
+    if corr_quant_enabled(cfg):
+        # int8 from the fp32 volume (not the bf16 round-trip): one
+        # rounding step instead of two.
+        pyramid_f32 = build_corr_pyramid(
+            build_corr_volume(fmap1.astype(jnp.float32),
+                              fmap2.astype(jnp.float32)), cfg.corr_levels)
+        pyramid_q, scales = quantize_pyramid(pyramid_f32, cfg)
+        if fused_lookup_available():
+            scale_vec = _tap_scale_vector(scales, cfg.corr_radius)
+
+            def corr_fn(coords):
+                raw = lookup_pyramid_fused_q(pyramid_q, coords,
+                                             cfg.corr_radius,
+                                             out_dtype=jnp.float32)
+                return (raw * scale_vec).astype(compute_dtype)
+        else:
+            pyramid = _dequantize_levels(pyramid_q, scales, compute_dtype)
+
+            def corr_fn(coords):
+                return lookup_pyramid_xla(pyramid, coords, cfg.corr_radius)
+        return corr_fn
+
     pyramid = build_corr_pyramid(
         build_corr_volume(fmap1.astype(jnp.float32),
                           fmap2.astype(jnp.float32)).astype(compute_dtype),
         cfg.corr_levels)
-
     if fused_lookup_available():
         def corr_fn(coords):
             return lookup_pyramid_fused(pyramid, coords, cfg.corr_radius)

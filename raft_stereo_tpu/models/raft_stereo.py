@@ -102,7 +102,8 @@ class RAFTStereo(nn.Module):
 
     def __call__(self, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = 12, flow_init: Optional[jnp.ndarray] = None,
-                 test_mode: bool = False, unroll_gru: bool = False):
+                 test_mode: bool = False, unroll_gru: bool = False,
+                 ctx_init=None, return_ctx: bool = False):
         """Estimate disparity for a rectified stereo pair.
 
         Args:
@@ -128,9 +129,38 @@ class RAFTStereo(nn.Module):
             trip count, so only an unrolled executable carries honest
             per-iteration flops.  Not for deployment: compile time grows
             with ``iters``.
+          ctx_init: test-mode only — a CONTEXT bundle from an earlier
+            frame's ``return_ctx`` output: ``(net_list, context)`` with
+            ``net_list`` the per-level post-tanh initial hidden states
+            and ``context`` the per-level (cz, cr, cq) GRU biases.  When
+            given, the context encoder (cnet + the context_zqr convs) is
+            SKIPPED entirely and the bundle is used in its place — the
+            per-session ctx cache behind streaming serving: for a static
+            camera the context of the scene does not change frame to
+            frame, and cnet is the dominant per-frame encoder cost at
+            streaming shapes (COST_REPORT_r10.json).  Unsupported with
+            ``shared_backbone`` (fnet is computed FROM the cnet trunk
+            there, so nothing is saved) and with ``rows_gru``.
+          return_ctx: test-mode only — also return that context bundle
+            (appended as the LAST element of the return tuple) so a
+            streaming session can carry it to the next frame.
         """
         cfg = self.config
         dtype = self.compute_dtype
+        reuse_ctx = ctx_init is not None and not self.is_initializing()
+        if (ctx_init is not None or return_ctx) and not test_mode:
+            raise ValueError("ctx_init/return_ctx are test-mode only "
+                             "(the streaming ctx cache is an inference "
+                             "feature)")
+        if reuse_ctx and cfg.shared_backbone:
+            raise ValueError(
+                "ctx_init is unsupported with shared_backbone: fnet is "
+                "computed from the cnet trunk there, so the context "
+                "encoder cannot be skipped")
+        if (ctx_init is not None or return_ctx) and cfg.rows_gru:
+            raise ValueError("ctx_init/return_ctx are unsupported with "
+                             "rows_gru (the sharded loop executor owns "
+                             "its own context layout)")
         image1 = (2 * (image1 / 255.0) - 1.0).astype(dtype)
         image2 = (2 * (image2 / 255.0) - 1.0).astype(dtype)
 
@@ -207,11 +237,12 @@ class RAFTStereo(nn.Module):
             # frames on a 16 GB chip or not (docs/TRAIN_PROFILE.md round 2).
             # With banded_encoder, each trunk additionally streams its
             # full-resolution stages band by band (models/banded.py).
-            with annotate("cnet"):
-                levels, _ = self.cnet(
-                    image1, trunk_out=custom_trunk(self.cnet, image1,
-                                                   cfg.context_norm)
-                    if custom_trunk is not None else None)
+            if not reuse_ctx:
+                with annotate("cnet"):
+                    levels, _ = self.cnet(
+                        image1, trunk_out=custom_trunk(self.cnet, image1,
+                                                       cfg.context_norm)
+                        if custom_trunk is not None else None)
 
             def fnet_one(module, carry, img):
                 trunk_out = (custom_trunk(module.fnet, img, cfg.fnet_norm)
@@ -225,20 +256,35 @@ class RAFTStereo(nn.Module):
                 _, fmaps = fnet_scan(self, None, jnp.stack([image1, image2]))
                 fmap1, fmap2 = fmaps[0], fmaps[1]
         else:
-            with annotate("cnet"):
-                levels, _ = self.cnet(image1)
+            if not reuse_ctx:
+                with annotate("cnet"):
+                    levels, _ = self.cnet(image1)
             with annotate("fnet"):
                 both = self.fnet(jnp.concatenate([image1, image2], axis=0))
                 fmap1, fmap2 = jnp.split(both, 2, axis=0)
 
-        # levels[l] = [hidden_head, context_head] at level l (fine→coarse)
-        net_list = [jnp.tanh(lv[0]) for lv in levels]
-        # Precompute GRU context biases cz, cr, cq once
-        # (reference: core/raft_stereo.py:87-88).
-        context = []
-        for l, lv in enumerate(levels):
-            biases = self.context_zqr_convs[l](nn.relu(lv[1]))
-            context.append(tuple(jnp.split(biases, 3, axis=-1)))
+        if reuse_ctx:
+            # The per-session ctx cache: the GRU's initial hidden states
+            # and context biases come from an earlier frame's bundle —
+            # cnet and the context_zqr convs never run in this program.
+            net_list = [jnp.asarray(n).astype(dtype) for n in ctx_init[0]]
+            context = [tuple(jnp.asarray(c).astype(dtype) for c in cs)
+                       for cs in ctx_init[1]]
+        else:
+            # levels[l] = [hidden_head, context_head] at level l
+            # (fine→coarse)
+            net_list = [jnp.tanh(lv[0]) for lv in levels]
+            # Precompute GRU context biases cz, cr, cq once
+            # (reference: core/raft_stereo.py:87-88).
+            context = []
+            for l, lv in enumerate(levels):
+                biases = self.context_zqr_convs[l](nn.relu(lv[1]))
+                context.append(tuple(jnp.split(biases, 3, axis=-1)))
+        # The carry-forward bundle: captured BEFORE the refinement loop
+        # (the initial states, not the evolved ones) so a later frame
+        # reusing it starts exactly where a cold frame would.
+        ctx_out = ((tuple(net_list), tuple(tuple(c) for c in context))
+                   if return_ctx else None)
 
         b, h8, w8, _ = net_list[0].shape
         disp = jnp.zeros((b, h8, w8), jnp.float32)
@@ -299,12 +345,14 @@ class RAFTStereo(nn.Module):
             disp = disp + delta_flow[..., 0].astype(jnp.float32)
             return net_list, disp, up_mask
 
+        ctx_tail = (ctx_out,) if return_ctx else ()
+
         if test_mode and unroll_gru:
             mask = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
             for _ in range(iters):
                 net_list, disp, mask = gru_step(self, net_list, disp)
             flow_up = self._upsample(disp, mask)
-            return disp, flow_up
+            return (disp, flow_up) + ctx_tail
 
         if (test_mode and cfg.exit_threshold_px > 0
                 and not self.is_initializing()):
@@ -347,7 +395,7 @@ class RAFTStereo(nn.Module):
             (net_fin, disp_fin, mask_fin, iters_used, _delta) = (
                 nn.while_loop(cond_exit, body_exit, self, carry))
             flow_up = self._upsample(disp_fin, mask_fin)
-            return disp_fin, flow_up, iters_used
+            return (disp_fin, flow_up, iters_used) + ctx_tail
 
         if test_mode:
             # No per-iteration outputs needed; the scan carries state (plus
@@ -365,7 +413,7 @@ class RAFTStereo(nn.Module):
             (net_fin, disp_fin, mask_fin), _ = scan_test(
                 self, (tuple(net_list), disp, mask0), None)
             flow_up = self._upsample(disp_fin, mask_fin)
-            return disp_fin, flow_up
+            return (disp_fin, flow_up) + ctx_tail
 
         def body_train(module, carry, _):
             net_list, disp = carry

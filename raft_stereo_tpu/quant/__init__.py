@@ -1,0 +1,29 @@
+"""Post-training int8 quantization: the low-precision inference tier
+behind the measured-drift gate (ROADMAP open item 2).
+
+``core`` holds the per-channel symmetric weight quantization and the
+pyramid/feature activation quantizers; ``calibrate`` runs the
+in-distribution calibration pass and owns the checkpoint-adjacent scale
+file.  See docs/architecture.md §Quantization for the tier ladder
+placement and the drift-gate policy (tools/quant_drift.py)."""
+
+from raft_stereo_tpu.quant.calibrate import (DEFAULT_PERCENTILE,
+                                             SCALES_VERSION, calibrate,
+                                             corr_scales, load_scales,
+                                             save_scales)
+from raft_stereo_tpu.quant.core import (QUANT_MODES, clipped_scale,
+                                        dequantize_array,
+                                        dequantize_variables,
+                                        dynamic_scale, is_quantized_leaf,
+                                        quantize_array,
+                                        quantize_symmetric,
+                                        quantize_variables,
+                                        quantized_param_bytes,
+                                        tree_is_quantized)
+
+__all__ = ["DEFAULT_PERCENTILE", "QUANT_MODES", "SCALES_VERSION",
+           "calibrate", "clipped_scale", "corr_scales",
+           "dequantize_array", "dequantize_variables", "dynamic_scale",
+           "is_quantized_leaf", "load_scales", "quantize_array",
+           "quantize_symmetric", "quantize_variables",
+           "quantized_param_bytes", "save_scales", "tree_is_quantized"]

@@ -19,7 +19,8 @@ from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
                                            InjectedWorkerCrash,
                                            parse_chaos_spec)
 from raft_stereo_tpu.serving.engine import (FAMILY_BASE, FAMILY_STATE,
-                                            FAMILY_WARM, BucketPolicy,
+                                            FAMILY_STATE_CTX, FAMILY_WARM,
+                                            FAMILY_WARM_CTX, BucketPolicy,
                                             ServeConfig, ServeResult,
                                             ServingEngine, StereoService)
 from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
@@ -48,6 +49,7 @@ __all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
            "enable_persistent_compilation_cache", "executable_cache_key",
            "CIRCUIT_CLOSED", "CIRCUIT_HALF_OPEN", "CIRCUIT_OPEN",
            "BrownoutController", "CircuitBreaker", "circuit_state_name",
-           "cost_ladder", "FAMILY_BASE", "FAMILY_STATE", "FAMILY_WARM",
+           "cost_ladder", "FAMILY_BASE", "FAMILY_STATE",
+           "FAMILY_STATE_CTX", "FAMILY_WARM", "FAMILY_WARM_CTX",
            "SessionExpired", "SessionsDisabled", "SessionStore",
            "StereoSession", "frame_delta", "frame_thumbnail"]

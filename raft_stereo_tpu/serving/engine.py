@@ -62,6 +62,23 @@ Round 13 adds the failure story (docs/architecture.md §Resilience):
   disk keyed by (config, shape, batch, tier, backend fingerprint), so a
   restarted process prewarm is disk-bound, not compile-bound, and the
   ``ready`` gate (/readyz) opens in seconds.
+
+Round 15 adds the int8 turbo tier and the per-session context cache
+(docs/architecture.md §Quantization, §Streaming sessions):
+
+* **Int8 tiers** — a tier with ``RequestTier.quant == "int8"`` (the
+  "turbo" preset) compiles against the quantized variable tree
+  (``_vars_for``: host-quantized once, device-put per worker; the fp32
+  tree and every full-precision tier are untouched) with the int8
+  correlation pyramid in its programs; its executables carry distinct
+  compile-cost keys (``...,quant=int8``) and persistent-cache keys, join
+  prewarm + /readyz, and sort to the BOTTOM of the brownout cost ladder.
+* **Session ctx cache** (``session_ctx_cache``) — static-camera streams
+  reuse the session's cnet context bundle: cold frames run the
+  ``state_ctx`` family (also returns the bundle), coherent warm frames
+  run ``warm_ctx`` (the context encoder never executes); invalidated by
+  scene cuts, the keyframe guard, and any frame past the
+  ``ctx_cache_threshold`` static-scene gate.
 """
 
 from __future__ import annotations
@@ -107,9 +124,19 @@ MODEL_DIVIS = 32
 # (eval/runner.make_forward): the base sessionless program, the
 # state-returning program session cold frames run (same math, one extra
 # low-res output), and the warm program that also consumes a flow_init.
+# The *_CTX variants (round 15, ``ServeConfig.session_ctx_cache``) add
+# the per-session CONTEXT cache: cold frames run "state_ctx" (also
+# returns the context bundle) and coherent warm frames run "warm_ctx"
+# (consumes the bundle and SKIPS the context encoder — cnet is the
+# dominant per-frame encoder cost at streaming shapes).
 FAMILY_BASE = None
 FAMILY_STATE = "state"
 FAMILY_WARM = "warm"
+FAMILY_STATE_CTX = "state_ctx"
+FAMILY_WARM_CTX = "warm_ctx"
+
+# Families that consume a flow_init input / reuse a context bundle.
+_WARM_FAMILIES = (FAMILY_WARM, FAMILY_WARM_CTX)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -248,6 +275,29 @@ class ServeConfig:
     # definition.  No effect on fixed-depth tiers (every frame runs the
     # cap there by construction).
     session_reseed_on_cap: bool = True
+    # Per-session CONTEXT-feature cache (round 15): for streams whose
+    # inter-frame thumbnail delta stays tiny (static camera), reuse the
+    # session's cnet context bundle instead of re-encoding it every
+    # frame — cold frames run the "state_ctx" family (also returns the
+    # bundle), coherent warm frames run "warm_ctx" (consumes it; the
+    # context encoder never executes).  Invalidated by scene cuts, the
+    # keyframe guard, and any frame whose delta exceeds the gate below
+    # (the bundle re-establishes at the next cold frame).  Requires
+    # ``sessions``; unsupported with shared_backbone (fnet is computed
+    # FROM the cnet trunk there).  Responses carry X-Ctx-Cached and
+    # hits count into serve_session_ctx_cache_hits_total.
+    session_ctx_cache: bool = False
+    # Mean inter-frame |Δintensity| (0..255) at or below which a warm
+    # frame may reuse the cached context.  Far below the scene-cut
+    # threshold by design: context reuse assumes the SCENE is static,
+    # not merely continuous.
+    ctx_cache_threshold: float = 2.0
+    # ---- Int8 turbo tier (round 15; quant/) ----------------------------
+    # Checkpoint-adjacent calibration scale file (quant/calibrate.py):
+    # when set, tiers on the int8 path compile with the calibrated
+    # percentile-clipped correlation-pyramid scales instead of dynamic
+    # in-graph max-abs scales.  None = dynamic scales.
+    quant_scales_path: Optional[str] = None
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -323,6 +373,15 @@ class ServeConfig:
             if self.session_capacity < 1:
                 raise ValueError(f"session_capacity="
                                  f"{self.session_capacity} must be >= 1")
+        if self.session_ctx_cache:
+            if not self.sessions:
+                raise ValueError(
+                    "session_ctx_cache=True needs sessions=True — the "
+                    "context bundle is per-stream state")
+            if self.ctx_cache_threshold <= 0:
+                raise ValueError(
+                    f"ctx_cache_threshold={self.ctx_cache_threshold} "
+                    f"must be > 0 (the static-scene gate)")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -361,6 +420,12 @@ class ServeResult:
     scene_cut: bool = False
     frame_delta: Optional[float] = None
     flow_low: Optional[np.ndarray] = None
+    # Context-cache provenance (session_ctx_cache): ``ctx_cached`` — this
+    # frame REUSED the session's context bundle (the context encoder
+    # never ran; X-Ctx-Cached header); ``ctx`` — the bundle a cold
+    # state_ctx frame computed, folded back into the session.
+    ctx_cached: bool = False
+    ctx: Optional[object] = None
 
     @property
     def degraded(self) -> bool:
@@ -388,6 +453,8 @@ class _Payload:
     frame_index: Optional[int] = None
     scene_cut: bool = False
     frame_delta: Optional[float] = None
+    ctx_init: Optional[object] = None        # warm_ctx: the session's
+    #                                          cached context bundle
 
 
 class BucketPolicy:
@@ -556,15 +623,34 @@ class ServingEngine:
         # The model, with the same deep-iteration corr_fp32 guard the solo
         # runner applies — both paths compile the identical program.
         self.config = config
-        self.effective_config = effective_inference_config(
-            config, serve_cfg.iters)
+        # Calibrated correlation scales for int8 tiers (quant/calibrate):
+        # loaded once and swapped into every quant tier's effective
+        # config, so the compiled programs carry the percentile-clipped
+        # constants instead of dynamic in-graph reductions.
+        self._quant_corr_scales = None
+        if serve_cfg.quant_scales_path:
+            from raft_stereo_tpu.quant import corr_scales, load_scales
+            self._quant_corr_scales = corr_scales(
+                load_scales(serve_cfg.quant_scales_path))
+
+        def effective(cfg_in: RaftStereoConfig) -> RaftStereoConfig:
+            eff = effective_inference_config(cfg_in, serve_cfg.iters)
+            if (eff.quant != "off" and self._quant_corr_scales is not None
+                    and eff.quant_corr_scales is None):
+                eff = dataclasses.replace(
+                    eff, quant_corr_scales=self._quant_corr_scales)
+            return eff
+
+        self.effective_config = effective(config)
         self.model = RAFTStereo(self.effective_config)
         # Latency tiers: one effective config / model per tier (the
-        # early-exit knobs swapped into the SAME architecture — the
-        # parameter tree is shared, only the compiled loop differs).  A
-        # tier whose effective config equals the base one (threshold <= 0,
-        # e.g. "quality") maps to the base model so its requests share the
-        # base executables — the bitwise-parity bucket stays one program.
+        # early-exit + quant knobs swapped into the SAME architecture —
+        # the parameter tree is shared, only the compiled program
+        # differs).  A tier whose effective config equals the base one
+        # (threshold <= 0, e.g. "quality") maps to the base model so its
+        # requests share the base executables — the bitwise-parity
+        # bucket stays one program.  Int8 tiers ("turbo") get their own
+        # model AND their own quantized variable tree (_vars_for).
         self.tiers: Dict[str, RequestTier] = {
             t.name: t for t in serve_cfg.parsed_tiers()}
         self.default_tier: Optional[str] = None
@@ -575,16 +661,28 @@ class ServingEngine:
         self._tier_models: Dict[Optional[str], RAFTStereo] = {
             None: self.model}
         for name, tier in self.tiers.items():
-            eff = effective_inference_config(tier.apply(config),
-                                             serve_cfg.iters)
+            eff = effective(tier.apply(config))
             self._tier_models[name] = (
                 self.model if eff == self.effective_config
                 else RAFTStereo(eff))
+        if serve_cfg.session_ctx_cache and config.shared_backbone:
+            raise ValueError(
+                "session_ctx_cache is unsupported with shared_backbone: "
+                "fnet is computed from the cnet trunk, so the context "
+                "encoder cannot be skipped (models/raft_stereo.py)")
         # Per-worker resident variables + the engine-owned executable
         # cache: (worker, padded shape, batch size) -> compiled forward,
         # bounded per worker, oldest evicted.
         self._worker_vars = [jax.device_put(variables, d)
                              for d in self.devices]
+        # Int8 tiers' per-worker quantized trees, built lazily: the host
+        # quantization (quant/core.quantize_variables) runs at most once
+        # per engine and each worker keeps its own device copy — the
+        # fp32 ``_worker_vars`` stay untouched for full-precision tiers.
+        self._host_variables = variables
+        self._qvars_lock = threading.Lock()
+        self._qvars_host = None
+        self._qvars: Dict[int, object] = {}
         self._cache_lock = threading.Lock()
         self._compiled: "collections.OrderedDict[Tuple, object]" = (
             collections.OrderedDict())
@@ -784,7 +882,8 @@ class ServingEngine:
                  thumb: Optional[np.ndarray] = None,
                  frame_index: Optional[int] = None,
                  scene_cut: bool = False,
-                 frame_delta_v: Optional[float] = None) -> Request:
+                 frame_delta_v: Optional[float] = None,
+                 ctx_init=None) -> Request:
         """Pad, build, trace, and queue one request — shared by the
         stateless ``submit`` (base family, no session fields) and the
         streaming ``submit_session``."""
@@ -798,7 +897,7 @@ class ServingEngine:
                            session=session, thumb=thumb,
                            raw_shape=tuple(left.shape[:2]),
                            frame_index=frame_index, scene_cut=scene_cut,
-                           frame_delta=frame_delta_v)
+                           frame_delta=frame_delta_v, ctx_init=ctx_init)
         now = time.monotonic()
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.serve_cfg.default_deadline_ms)
@@ -927,11 +1026,29 @@ class ServingEngine:
                         warm, scene_cut = False, True
                         sess.scene_cuts += 1
                         self.metrics.scene_cuts.inc()
+            # Family routing with the ctx cache on: cold frames SAVE the
+            # context bundle (state_ctx); a warm frame whose measured
+            # delta proves the scene static REUSES it (warm_ctx — the
+            # context encoder never runs); a warm frame past the gate
+            # runs plain warm AND the bundle is dropped at completion
+            # (the scene moved; a stale context is a silent accuracy
+            # leak, so it re-establishes at the next cold frame).
+            ctx_on = self.serve_cfg.session_ctx_cache
+            ctx_init = None
+            if warm:
+                family = FAMILY_WARM
+                if (ctx_on and sess.ctx is not None and delta is not None
+                        and delta <= self.serve_cfg.ctx_cache_threshold):
+                    family = FAMILY_WARM_CTX
+                    ctx_init = sess.ctx
+            else:
+                family = FAMILY_STATE_CTX if ctx_on else FAMILY_STATE
             req = self._enqueue(
                 left, right, deadline_ms, tier, requested_tier, t_admit,
-                family=FAMILY_WARM if warm else FAMILY_STATE,
+                family=family,
                 session=sess, session_id=session_id,
                 flow_init=sess.flow_low if warm else None,
+                ctx_init=ctx_init,
                 thumb=thumb, frame_index=sess.frame_index,
                 scene_cut=scene_cut, frame_delta_v=delta)
         except BaseException:
@@ -974,6 +1091,7 @@ class ServingEngine:
             if future.exception() is None:
                 res = future.result()
                 flow_low = res.flow_low
+                reseed = False
                 if (self.serve_cfg.session_reseed_on_cap and res.warm
                         and res.iters_used is not None
                         and res.iters_used >= self.serve_cfg.iters
@@ -984,7 +1102,22 @@ class ServingEngine:
                     # trusted init — drop the state and let the next
                     # frame cold-start.
                     flow_low = None
+                    reseed = True
                     self.metrics.session_reseeds.inc()
+                if self.serve_cfg.session_ctx_cache:
+                    if res.ctx is not None:
+                        # Cold state_ctx frame: (re-)establish the bundle.
+                        sess.ctx = res.ctx
+                    elif reseed or (res.warm and not res.ctx_cached):
+                        # Invalidated: the keyframe guard fired, or a
+                        # warm frame ran past the static-scene gate —
+                        # either way the cached context no longer
+                        # describes the scene; it re-establishes at the
+                        # next cold frame.
+                        sess.ctx = None
+                    if res.ctx_cached:
+                        sess.ctx_hits += 1
+                        self.metrics.ctx_cache_hits.inc()
                 sess.note_result(
                     flow_low=flow_low, thumb=req.payload.thumb,
                     bucket=req.bucket, raw_shape=req.payload.raw_shape,
@@ -1035,10 +1168,58 @@ class ServingEngine:
         """The executable families this engine serves: the base program
         always; the session state/warm variants only when the session
         store exists (so a stateless engine's compile surface, prewarm
-        cost, and readiness target are exactly the round-13 ones)."""
+        cost, and readiness target are exactly the round-13 ones); the
+        ctx-cache variants replace state/warm when the per-session
+        context cache is on (cold frames must SAVE the bundle for warm
+        frames to reuse, so plain "state" never runs there)."""
         if self.sessions is None:
             return (FAMILY_BASE,)
+        if self.serve_cfg.session_ctx_cache:
+            return (FAMILY_BASE, FAMILY_STATE_CTX, FAMILY_WARM,
+                    FAMILY_WARM_CTX)
         return (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)
+
+    # ------------------------------------------------------- tier variables
+    def _vars_for(self, widx: int, cache_tier: Optional[str]):
+        """The variable tree a tier's executables consume on one worker:
+        the resident fp32 tree for full-precision tiers, the per-worker
+        int8 tree for quant tiers (built lazily, host-quantized once per
+        engine — disk checkpoints stay fp32)."""
+        if self._tier_models[cache_tier].config.quant == "off":
+            return self._worker_vars[widx]
+        import jax
+
+        with self._qvars_lock:
+            dev = self._qvars.get(widx)
+            if dev is None:
+                if self._qvars_host is None:
+                    from raft_stereo_tpu.quant import quantize_variables
+                    self._qvars_host = quantize_variables(
+                        self._host_variables)
+                dev = jax.device_put(self._qvars_host,
+                                     self.devices[widx])
+                self._qvars[widx] = dev
+        return dev
+
+    def _ctx_avals(self, cfg, bucket: Tuple[int, int], batch: int):
+        """Abstract shapes of one context bundle at ``bucket`` — what the
+        AOT persistent-cache path lowers the ctx families with and what
+        prewarm feeds as zeros (models/raft_stereo.py: per-level initial
+        hidden states + (cz, cr, cq) biases at 1/2^(downsample+l))."""
+        import jax
+        import jax.numpy as jnp
+
+        dt = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+        f = cfg.downsample_factor
+        nets, ctxs = [], []
+        for l in range(cfg.n_gru_layers):
+            h = bucket[0] // (f * 2 ** l)
+            w = bucket[1] // (f * 2 ** l)
+            c = cfg.hidden_dims[l]
+            nets.append(jax.ShapeDtypeStruct((batch, h, w, c), dt))
+            ctxs.append(tuple(jax.ShapeDtypeStruct((batch, h, w, c), dt)
+                              for _ in range(3)))
+        return (tuple(nets), tuple(ctxs))
 
     # --------------------------------------------------------- compile cache
     def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
@@ -1061,8 +1242,16 @@ class ServingEngine:
                   tier: Optional[str] = None,
                   family: Optional[str] = FAMILY_BASE) -> str:
         """Stable label of one compile point in the cost registry — what
-        GET /debug/compiles lists and the MFU path looks up."""
-        tail = "" if self._cache_tier(tier) is None else f",tier={tier}"
+        GET /debug/compiles lists and the MFU path looks up.  The quant
+        mode joins the key exactly like the family tag (the r14
+        warm/state split): an int8 tier's executable must never share a
+        cost record with the full-precision program of the same
+        (bucket, batch)."""
+        cache_tier = self._cache_tier(tier)
+        tail = "" if cache_tier is None else f",tier={tier}"
+        qmode = self._tier_models[cache_tier].config.quant
+        if qmode != "off":
+            tail += f",quant={qmode}"
         if family is not None:
             tail += f",{family}"
         return f"serving.forward({bucket[0]}x{bucket[1]},b{batch}{tail})"
@@ -1095,8 +1284,11 @@ class ServingEngine:
         fwd = make_forward(self._tier_models[tier], self.serve_cfg.iters,
                            self._fetch_jax_dtype(),
                            donate_images=self.serve_cfg.donate_buffers,
-                           warm_start=(family == FAMILY_WARM),
-                           return_state=(family is not FAMILY_BASE))
+                           warm_start=(family in _WARM_FAMILIES),
+                           return_state=(family is not FAMILY_BASE),
+                           ctx=("save" if family == FAMILY_STATE_CTX
+                                else "reuse" if family == FAMILY_WARM_CTX
+                                else None))
         if self.disk_cache is not None:
             fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
                                         family)
@@ -1148,7 +1340,13 @@ class ServingEngine:
             tier=cache_tier, iters=self.serve_cfg.iters,
             fetch_dtype=self.serve_cfg.fetch_dtype,
             donate=self.serve_cfg.donate_buffers,
-            family=family, flow_init=(family == FAMILY_WARM),
+            family=family, flow_init=(family in _WARM_FAMILIES),
+            # Belt and braces for the int8 tier: the quant mode is
+            # already inside the config JSON above, but it also keys
+            # explicitly — a quantized and a base executable consume
+            # DIFFERENT input trees (int8 packs vs fp32 kernels) and
+            # must never collide on one disk entry (tests/test_quant.py).
+            quant=self._tier_models[cache_tier].config.quant,
             device=str(getattr(self.devices[worker], "id", worker)))
 
     def _load_or_compile(self, fwd, bucket: Tuple[int, int], batch: int,
@@ -1180,12 +1378,15 @@ class ServingEngine:
         aval = jax.ShapeDtypeStruct((batch, bucket[0], bucket[1], 3),
                                     np.uint8)
         avals = [aval, aval]
-        if family == FAMILY_WARM:
-            f = self._tier_models[cache_tier].config.downsample_factor
+        tier_cfg = self._tier_models[cache_tier].config
+        if family in _WARM_FAMILIES:
+            f = tier_cfg.downsample_factor
             avals.append(jax.ShapeDtypeStruct(
                 (batch, bucket[0] // f, bucket[1] // f), np.float32))
+        if family == FAMILY_WARM_CTX:
+            avals.append(self._ctx_avals(tier_cfg, bucket, batch))
         try:
-            compiled = fwd.lower(self._worker_vars[worker],
+            compiled = fwd.lower(self._vars_for(worker, cache_tier),
                                  *avals).compile()
         except Exception:
             log.warning("AOT compile for the persistent cache failed; "
@@ -1247,15 +1448,22 @@ class ServingEngine:
                         fwd = self._forward_for((hp, wp), n, worker=widx,
                                                 tier=tier, family=family)
                         zeros = np.zeros((n, hp, wp, 3), np.uint8)
-                        args = [self._worker_vars[widx],
+                        args = [self._vars_for(widx, tier),
                                 jax.device_put(zeros, dev),
                                 jax.device_put(zeros.copy(), dev)]
-                        if family == FAMILY_WARM:
-                            f = (self._tier_models[tier]
-                                 .config.downsample_factor)
+                        tier_cfg = self._tier_models[tier].config
+                        if family in _WARM_FAMILIES:
+                            f = tier_cfg.downsample_factor
                             args.append(jax.device_put(
                                 np.zeros((n, hp // f, wp // f),
                                          np.float32), dev))
+                        if family == FAMILY_WARM_CTX:
+                            import jax.tree_util as jtu
+                            ctx_zeros = jtu.tree_map(
+                                lambda s: jax.device_put(
+                                    np.zeros(s.shape, s.dtype), dev),
+                                self._ctx_avals(tier_cfg, (hp, wp), n))
+                            args.append(ctx_zeros)
                         out = fwd(*args)
                         jax.block_until_ready(out)
                         self._note_warm(widx, (hp, wp), n, tier, family)
@@ -1439,15 +1647,24 @@ class ServingEngine:
                 self._tier_models[self._cache_tier(tier)].config)
             p1 = np.stack([r.payload.left for r in batch])
             p2 = np.stack([r.payload.right for r in batch])
-            args = [self._worker_vars[widx],
+            args = [self._vars_for(widx, self._cache_tier(tier)),
                     jax.device_put(p1, device),
                     jax.device_put(p2, device)]
-            if family == FAMILY_WARM:
+            if family in _WARM_FAMILIES:
                 # Warm session frames: the batch's previous-frame states
                 # stack into the program's flow_init input.
                 fi = np.stack([r.payload.flow_init for r in batch]
                               ).astype(np.float32)
                 args.append(jax.device_put(fi, device))
+            if family == FAMILY_WARM_CTX:
+                # Context reuse: the batch members' cached bundles stack
+                # leaf-wise (frames of DIFFERENT static-scene sessions
+                # batch together; each leaf is per-image along axis 0).
+                import jax.tree_util as jtu
+                ctx_stacked = jtu.tree_map(
+                    lambda *xs: np.stack(xs),
+                    *[r.payload.ctx_init for r in batch])
+                args.append(jax.device_put(ctx_stacked, device))
             out = fwd(*args)
             # Advisory device clock: honest on a local backend; behind an
             # async tunnel readiness reports at dispatch (profiling.py) and
@@ -1458,6 +1675,14 @@ class ServingEngine:
 
         with profiling.annotate("serve.fetch"):
             flow_low_padded = None
+            ctx_out = None
+            if family == FAMILY_STATE_CTX:
+                # The ctx-saving cold program appends the context bundle
+                # LAST (eval/runner.make_forward): peel it off, fetch it
+                # to host leaves (numpy; bf16 leaves ride as ml_dtypes).
+                import jax.tree_util as jtu
+                out, ctx_dev = out[:-1], out[-1]
+                ctx_out = jtu.tree_map(lambda x: np.asarray(x), ctx_dev)
             if family is FAMILY_BASE:
                 if adaptive:
                     flows, iters_used_dev = out
@@ -1532,6 +1757,13 @@ class ServingEngine:
             self.metrics.queue_wait.observe(wait, exemplar=exemplar)
             self.metrics.total_latency.observe(total, exemplar=exemplar)
             self.metrics.completed.inc()
+            ctx_i = None
+            if ctx_out is not None:
+                # Per-member slice of the batch's returned bundle: the
+                # session stores a batch-axis-free copy it can stack
+                # into any later dispatch.
+                import jax.tree_util as jtu
+                ctx_i = jtu.tree_map(lambda leaf, j=i: leaf[j], ctx_out)
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
@@ -1539,11 +1771,13 @@ class ServingEngine:
                 requested_tier=r.requested_tier, attempts=r.attempts + 1,
                 session_id=r.session_id,
                 frame_index=r.payload.frame_index,
-                warm=(family == FAMILY_WARM),
+                warm=(family in _WARM_FAMILIES),
                 scene_cut=r.payload.scene_cut,
                 frame_delta=r.payload.frame_delta,
                 flow_low=(np.ascontiguousarray(flow_low_padded[i])
-                          if flow_low_padded is not None else None)))
+                          if flow_low_padded is not None else None),
+                ctx_cached=(family == FAMILY_WARM_CTX),
+                ctx=ctx_i))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

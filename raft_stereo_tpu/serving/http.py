@@ -319,6 +319,8 @@ def make_handler(service: StereoService,
                 headers.append(("X-Warm", "1" if result.warm else "0"))
                 if result.scene_cut:
                     headers.append(("X-Scene-Cut", "1"))
+                if result.ctx_cached:
+                    headers.append(("X-Ctx-Cached", "1"))
                 if result.frame_delta is not None:
                     headers.append(("X-Frame-Delta",
                                     f"{result.frame_delta:.2f}"))

@@ -185,6 +185,11 @@ class ServingMetrics:
             "session states dropped by the keyframe guard: a warm frame "
             "ran to the iteration cap without converging, so the next "
             "frame cold-starts (session_reseed_on_cap)")
+        self.ctx_cache_hits = r.counter(
+            "serve_session_ctx_cache_hits_total",
+            "session frames served with the cached context bundle (the "
+            "context encoder never ran — session_ctx_cache; the "
+            "X-Ctx-Cached response header marks these)")
         self.frame_delta = r.histogram(
             "serve_session_frame_delta",
             "mean |delta intensity| (0..255) between consecutive session "

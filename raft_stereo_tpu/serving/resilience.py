@@ -287,12 +287,17 @@ class BrownoutController:
 def cost_ladder(tiers) -> List[str]:
     """Tier names cheapest-first for the brownout ladder: higher
     early-exit threshold = earlier exit = cheaper; fixed-depth tiers
-    (threshold <= 0) are the most expensive.  Ties keep configuration
-    order.  ``tiers`` is a sequence of ``config.RequestTier``."""
+    (threshold <= 0) are the most expensive; at equal exit knobs an
+    int8 tier is cheaper than the full-precision one (it moves a
+    fraction of the bytes per iteration — the round-15 "turbo" tier
+    sits below "interactive" as the ladder's bottom rung).  Ties keep
+    configuration order.  ``tiers`` is a sequence of
+    ``config.RequestTier``."""
     order = sorted(
         enumerate(tiers),
         key=lambda it: (it[1].exit_threshold_px <= 0,
                         -it[1].exit_threshold_px
                         if it[1].exit_threshold_px > 0 else 0,
+                        getattr(it[1], "quant", "off") == "off",
                         it[0]))
     return [t.name for _, t in order]

@@ -114,6 +114,14 @@ class StereoSession:
     raw_shape: Optional[Tuple[int, int]] = None
     flow_low: Optional[np.ndarray] = None
     thumb: Optional[np.ndarray] = None
+    # Cached CONTEXT bundle (engine session_ctx_cache): the per-level
+    # initial GRU hidden states + context biases a cold state_ctx frame
+    # computed, reused by warm_ctx frames while the inter-frame delta
+    # proves the scene static; None until a cold frame saves one (and
+    # again after any invalidation — scene cut, keyframe guard, a warm
+    # frame past the static-scene gate).
+    ctx: Optional[object] = None
+    ctx_hits: int = 0             # frames served with the cached context
     frame_index: int = 0          # frames COMPLETED (the next frame's index)
     warm_frames: int = 0
     cold_frames: int = 0
@@ -162,6 +170,7 @@ class StereoSession:
             "warm_frames": self.warm_frames,
             "cold_frames": self.cold_frames,
             "scene_cuts": self.scene_cuts,
+            "ctx_cache_hits": self.ctx_hits,
             "iters_used_mean": (round(self.iters_used_mean(), 3)
                                 if self.iters_used_mean() is not None
                                 else None),

@@ -51,7 +51,11 @@ _EXEC_CONFIG_FIELDS = (
     "corr_backend", "fused_gru", "slow_fast_gru", "mixed_precision",
     "corr_fp32", "banded_encoder", "corr_w2_shards", "rows_shards",
     "rows_gru", "rows_gru_halo", "remat_gru", "remat_save",
-    "sequential_fnet_pixels", "band_rows")
+    "sequential_fnet_pixels", "band_rows",
+    # round 15: the int8 inference-tier knobs are pure execution choices
+    # (params on disk stay fp32), so the caller's setting wins over
+    # whatever the checkpoint was saved with.
+    "quant", "quant_corr", "quant_corr_scales")
 
 
 def merge_warm_start_config(caller_cfg: RaftStereoConfig,

@@ -1,0 +1,447 @@
+"""Int8 quantized inference tier tests (tier-1, CPU): the round-15
+turbo path.
+
+Headline pins (the ISSUE acceptance properties):
+
+* ``quant="off"`` is BITWISE the pre-quant program — no int8 ops trace
+  into either the fixed-depth scan or the early-exit while program, and
+  the quality tier's outputs equal the raw config's outputs exactly.
+* Calibration is deterministic: same pairs -> byte-identical scale
+  record; the scale file round-trips and version/mode-checks.
+* Quantized and base executables can never collide in the persistent
+  disk cache (distinct content keys) or the compile-cost registry
+  (distinct key labels with the ``quant=int8`` tail).
+* The int8 correlation pyramid's fused-kernel path (interpret mode)
+  matches the XLA dequant fallback — the backend-independence contract
+  of the kernel family.
+* The per-session context cache reuses/invalidates correctly and its
+  reuse program is numerically identical to the plain warm program.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import (REQUEST_TIERS, RaftStereoConfig,
+                                    parse_tier)
+from raft_stereo_tpu.quant import (calibrate, corr_scales,
+                                   dequantize_variables, load_scales,
+                                   quantize_array, quantize_variables,
+                                   quantized_param_bytes, save_scales,
+                                   tree_is_quantized)
+
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+def _pair(hw=(32, 48), seed=3):
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+    return left, np.roll(left, -3, axis=1)
+
+
+# ------------------------------------------------------------- core quant
+def test_quantize_array_per_channel_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(3, 3, 8, 16)).astype(np.float32) * \
+        np.linspace(0.1, 10.0, 16, dtype=np.float32)  # per-channel ranges
+    q, s = quantize_array(w)
+    assert q.dtype == np.int8 and s.shape == (1, 1, 1, 16)
+    # per-channel scales: each channel's error bounded by ITS half-step,
+    # the whole point over a per-tensor scale (Wu et al. 2020 §4)
+    err = np.abs(q.astype(np.float32) * s - w)
+    assert np.all(err <= 0.5 * s + 1e-7)
+    # all-zero channels reproduce exactly (scale 1, q 0)
+    w[..., 3] = 0.0
+    q, s = quantize_array(w)
+    assert np.all(q[..., 3] == 0) and s[0, 0, 0, 3] == 1.0
+
+
+def test_quantize_variables_scope_and_dequant(tiny_model):
+    _, variables = tiny_model
+    qvars = quantize_variables(variables)
+    assert tree_is_quantized(qvars)
+    # encoder kernels packed; the update block stays full precision
+    p = qvars["params"]
+    assert "q8" in p["fnet"]["trunk"]["conv1"]["kernel"]
+    assert "q8" in p["cnet"]["trunk"]["conv1"]["kernel"]
+    assert "q8" in p["context_zqr_conv0"]["kernel"]
+    flat_ub = p["update_block"]
+    assert not tree_is_quantized({"params": flat_ub})
+    # biases/norms untouched
+    assert np.asarray(
+        p["fnet"]["trunk"]["conv1"]["bias"]).dtype == np.float32
+    # structural inverse + bounded error
+    dq = dequantize_variables(qvars)
+    orig = np.asarray(variables["params"]["fnet"]["trunk"]["conv1"]
+                      ["kernel"])
+    back = np.asarray(dq["params"]["fnet"]["trunk"]["conv1"]["kernel"])
+    assert back.shape == orig.shape
+    assert np.max(np.abs(back - orig)) <= np.max(np.abs(orig)) / 127 + 1e-6
+    acct = quantized_param_bytes(qvars)
+    assert acct["int8"] > 0 and acct["scales"] > 0
+
+
+def test_quant_config_validation():
+    with pytest.raises(ValueError, match="quant="):
+        RaftStereoConfig(**TINY, quant="fp8")
+    with pytest.raises(ValueError, match="rows_shards"):
+        RaftStereoConfig(**TINY, quant="int8", rows_shards=2)
+    with pytest.raises(ValueError, match="quant_corr_scales"):
+        RaftStereoConfig(**TINY, quant="int8", quant_corr_scales=(1.0,))
+    cfg = RaftStereoConfig(**TINY, quant="int8",
+                           quant_corr_scales=(.1, .2, .3, .4))
+    assert cfg.from_json(cfg.to_json()) == cfg
+
+
+def test_turbo_tier_preset_and_ladder():
+    from raft_stereo_tpu.serving.resilience import cost_ladder
+
+    turbo = REQUEST_TIERS["turbo"]
+    assert turbo.quant == "int8" and turbo.exit_threshold_px > 0
+    inline = parse_tier("fast8:0.1:2:int8")
+    assert inline.quant == "int8" and inline.min_iters == 2
+    with pytest.raises(ValueError, match="quant"):
+        parse_tier("bad:0.1:2:fp8")
+    tiers = [parse_tier(t) for t in
+             ("interactive", "balanced", "quality", "turbo")]
+    ladder = cost_ladder(tiers)
+    assert ladder[0] == "turbo" and ladder[-1] == "quality"
+
+
+# ------------------------------------------------------- quant-off bitwise
+def _jaxpr_has_int8(fn, *avals):
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*avals)
+    return "i8[" in str(jaxpr)
+
+
+def test_quant_off_traces_no_int8_scan_and_early_exit(tiny_model):
+    """The bitwise-off pin at the jaxpr level: with quant='off' neither
+    the fixed-depth scan program nor the early-exit while program
+    contains a single int8 op — the traced computation IS the pre-quant
+    one.  With quant='int8' both carry int8 (the positive control)."""
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg, variables = tiny_model
+    img = jnp.zeros((1, 32, 64, 3), jnp.uint8)
+    for exit_px in (0.0, 0.05):
+        base = dataclasses.replace(cfg, exit_threshold_px=exit_px)
+        fwd = make_forward(RAFTStereo(base), 2, donate_images=False)
+        assert not _jaxpr_has_int8(fwd, variables, img, img)
+        qcfg = dataclasses.replace(base, quant="int8")
+        qfwd = make_forward(RAFTStereo(qcfg), 2, donate_images=False)
+        qvars = quantize_variables(variables)
+        assert _jaxpr_has_int8(qfwd, qvars, img, img)
+
+
+def test_quality_tier_apply_is_identity_program(tiny_model):
+    """REQUEST_TIERS['quality'].apply (quant='off') on the base config
+    yields the base config exactly — the engine's shared-executable
+    normalization depends on this equality."""
+    cfg, _ = tiny_model
+    assert REQUEST_TIERS["quality"].apply(cfg) == dataclasses.replace(
+        cfg, exit_threshold_px=0.0, exit_min_iters=1, exit_max_iters=None)
+
+
+# ------------------------------------------------------------- calibration
+def test_calibration_deterministic_and_roundtrip(tiny_model, tmp_path):
+    cfg, variables = tiny_model
+    left, right = _pair()
+    pairs = [(left, right), _pair(seed=7)]
+    rec_a = calibrate(cfg, variables, pairs, percentile=99.5)
+    rec_b = calibrate(cfg, variables, pairs, percentile=99.5)
+    assert json.dumps(rec_a, sort_keys=True) == \
+        json.dumps(rec_b, sort_keys=True)
+    assert len(rec_a["corr_levels"]) == cfg.corr_levels
+    assert rec_a["n_pairs"] == 2 and rec_a["activations"]
+    # different data -> different scales (the record measures the input)
+    rec_c = calibrate(cfg, variables, [_pair(seed=99)], percentile=99.5)
+    assert rec_c["corr_levels"] != rec_a["corr_levels"]
+    # file round trip + guards
+    path = os.path.join(tmp_path, "scales.json")
+    save_scales(path, rec_a)
+    loaded = load_scales(path)
+    assert loaded["corr_levels"] == rec_a["corr_levels"]
+    scales = corr_scales(loaded)
+    assert len(scales) == cfg.corr_levels and all(s > 0 for s in scales)
+    bad = dict(rec_a, version=999)
+    save_scales(path, bad)
+    with pytest.raises(ValueError, match="version"):
+        load_scales(path)
+
+
+# ----------------------------------------------------------- int8 kernels
+def test_int8_pyramid_fused_matches_xla_fallback():
+    """Interpret-mode kernel parity: the fused int8 lookup (in-register
+    dequant, scale applied after) equals the XLA fallback (dequant then
+    sample) up to float associativity — same int8 grid either way."""
+    import jax.numpy as jnp
+
+    import raft_stereo_tpu.kernels.corr_lookup as cl
+    from raft_stereo_tpu.models.corr import make_corr_fn
+
+    rng = np.random.default_rng(1)
+    b, h, w, d = 1, 8, 128, 32
+    f1 = jnp.asarray(rng.normal(size=(b, h, w, d)).astype(np.float32))
+    f2 = jnp.asarray(rng.normal(size=(b, h, w, d)).astype(np.float32))
+    coords = jnp.asarray(
+        rng.uniform(0, w, size=(b, h, w)).astype(np.float32))
+    base = RaftStereoConfig(**TINY)
+    old = cl._interpret_override
+    try:
+        for backend in ("reg_fused", "alt"):
+            qcfg = dataclasses.replace(base, corr_backend=backend,
+                                       quant="int8")
+            cl._interpret_override = False     # XLA fallback path
+            ref = make_corr_fn(qcfg, f1, f2)(coords)
+            cl._interpret_override = True      # fused interpret kernels
+            fused = make_corr_fn(qcfg, f1, f2)(coords)
+            np.testing.assert_allclose(np.asarray(fused),
+                                       np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+    finally:
+        cl._interpret_override = old
+
+
+def test_int8_pyramid_calibrated_scales_clip():
+    """Calibrated (percentile-clipped) scales saturate outliers at
+    127*scale instead of blowing up the grid — the clip semantics the
+    PTQ literature prescribes."""
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.models.corr import quantize_pyramid
+
+    cfg = RaftStereoConfig(**TINY, quant="int8",
+                           quant_corr_scales=(0.01,) * 4)
+    vol = jnp.asarray(np.array([[[[0.5, -3.0, 0.002]]]], np.float32))
+    qs, scales = quantize_pyramid([vol] * 4, cfg)
+    q0 = np.asarray(qs[0])
+    assert q0[0, 0, 0, 0] == 50          # 0.5 / 0.01
+    assert q0[0, 0, 0, 1] == -127        # clipped
+    assert float(scales[0]) == pytest.approx(0.01)
+
+
+# --------------------------------------------------- runner / engine tier
+def test_runner_int8_close_to_fp32(tiny_model):
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    r_fp = InferenceRunner(cfg, variables, iters=2)
+    r_q = InferenceRunner(cfg, variables, iters=2, quant="int8")
+    assert tree_is_quantized(r_q.variables)
+    f_fp, _ = r_fp(left, right)
+    f_q, _ = r_q(left, right)
+    assert np.isfinite(f_q).all() and f_q.shape == f_fp.shape
+    # loose: random-init nets amplify perturbations; the trained-weights
+    # accuracy gate lives in tools/quant_drift.py
+    denom = max(np.abs(f_fp).mean(), 1.0)
+    assert np.abs(f_q - f_fp).mean() / denom < 0.5
+
+
+def test_persist_keys_never_collide(tiny_model):
+    """The acceptance pin: quantized and base executables get distinct
+    persistent-cache AND compile-cost keys at every (bucket, batch) —
+    exactly like the r14 warm/state family split."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=2,
+        tiers=("turbo", "interactive", "quality"),
+        default_tier="quality"))
+    try:
+        keys = {}
+        cost_keys = {}
+        for tier in (None, "turbo", "interactive"):
+            ct = svc._cache_tier(tier)
+            keys[tier] = svc._disk_key((32, 64), 1, 0, ct)
+            cost_keys[tier] = svc._cost_key((32, 64), 1, tier)
+        assert len(set(keys.values())) == 3, keys
+        assert "quant=int8" in cost_keys["turbo"]
+        assert "quant" not in cost_keys[None]
+        assert "quant" not in cost_keys["interactive"]
+        # family split keys stay distinct too (regression: r14 pin)
+        k_base = svc._disk_key((32, 64), 1, 0, "turbo", family=None)
+        k_state = svc._disk_key((32, 64), 1, 0, "turbo", family="state")
+        assert k_base != k_state
+    finally:
+        svc.close()
+
+
+def test_engine_turbo_tier_end_to_end(tiny_model):
+    """One engine, quality + turbo: turbo runs the int8 program (close
+    but not equal to quality), quality stays bitwise the solo fp32
+    runner, and the two tiers compile distinct cost records."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    solo = InferenceRunner(cfg, variables, iters=2,
+                           donate_images=False)
+    solo_flow, _ = solo(left, right)
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=2, cost_telemetry=True,
+        tiers=("turbo", "quality"), default_tier="quality"))
+    try:
+        r_q = svc.infer(left, right, tier="quality", timeout=300)
+        r_t = svc.infer(left, right, tier="turbo", timeout=300)
+        assert np.array_equal(r_q.flow, solo_flow), \
+            "quality tier must stay bitwise the solo fp32 program"
+        assert r_t.tier == "turbo"
+        assert not np.array_equal(r_t.flow, r_q.flow)
+        denom = max(np.abs(r_q.flow).mean(), 1.0)
+        assert np.abs(r_t.flow - r_q.flow).mean() / denom < 0.5
+        recs = {r.key for r in svc.costs.records()}
+        assert any("quant=int8" in k for k in recs), recs
+        assert any("quant" not in k for k in recs), recs
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------ session ctx cache
+def test_ctx_cache_config_validation(tiny_model):
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    with pytest.raises(ValueError, match="sessions"):
+        ServeConfig(session_ctx_cache=True)
+    cfg, variables = tiny_model
+    shared = dataclasses.replace(cfg, shared_backbone=True,
+                                 n_downsample=3, n_gru_layers=2)
+    with pytest.raises(ValueError, match="shared_backbone"):
+        StereoService(shared, variables, ServeConfig(
+            sessions=True, session_ctx_cache=True))
+
+
+def test_ctx_reuse_program_matches_plain_warm(tiny_model):
+    """The warm_ctx program fed the bundle a cold state_ctx frame saved
+    produces EXACTLY the plain warm program's output: skipping the
+    context encoder is a pure compute-reuse, not an approximation."""
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(cfg)
+    left, right = _pair()
+    p1 = jnp.asarray(np.pad(left, ((0, 0), (0, 16), (0, 0)),
+                            mode="edge")[None])
+    p2 = jnp.asarray(np.pad(right, ((0, 0), (0, 16), (0, 0)),
+                            mode="edge")[None])
+    fwd_save = make_forward(model, 2, return_state=True, ctx="save",
+                            donate_images=False)
+    flow_up0, flow_low0, ctx = fwd_save(variables, p1, p2)
+    # the ctx-saving cold program's flow equals the base program's
+    fwd_base = make_forward(model, 2, donate_images=False)
+    np.testing.assert_array_equal(np.asarray(flow_up0),
+                                  np.asarray(fwd_base(variables, p1, p2)))
+    fwd_warm = make_forward(model, 2, warm_start=True,
+                            donate_images=False)
+    fwd_reuse = make_forward(model, 2, warm_start=True, ctx="reuse",
+                             donate_images=False)
+    out_warm = fwd_warm(variables, p1, p2, flow_low0)
+    out_reuse = fwd_reuse(variables, p1, p2, flow_low0, ctx)
+    np.testing.assert_array_equal(np.asarray(out_reuse[0]),
+                                  np.asarray(out_warm[0]))
+
+
+def test_engine_session_ctx_cache_hits_and_invalidation(tiny_model):
+    """Static-camera stream: frame 0 cold (bundle saved), later frames
+    reuse it (X-Ctx-Cached semantics, counter, per-session stats); a
+    frame past the static-scene gate drops the bundle; a scene cut
+    recomputes it."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    bright = np.clip(left.astype(np.int32) + 30, 0, 255).astype(np.uint8)
+    dark = (left * 0.2).astype(np.uint8)
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=2,
+        sessions=True, session_ttl_s=600.0,
+        session_ctx_cache=True, ctx_cache_threshold=3.0,
+        scene_cut_threshold=40.0))
+    try:
+        r0 = svc.infer_session("s", left, right, timeout=300)
+        assert not r0.warm and not r0.ctx_cached and r0.ctx is not None
+        r1 = svc.infer_session("s", left, right, timeout=300)
+        assert r1.warm and r1.ctx_cached
+        r2 = svc.infer_session("s", left, right, timeout=300)
+        assert r2.warm and r2.ctx_cached
+        assert svc.metrics.ctx_cache_hits.value == 2
+        # moderate delta: warm WITHOUT ctx (> gate, < scene cut) and the
+        # bundle is invalidated — the next small-delta frame cannot hit
+        r3 = svc.infer_session("s", bright, right, timeout=300)
+        assert r3.warm and not r3.ctx_cached and not r3.scene_cut
+        r4 = svc.infer_session("s", bright, right, timeout=300)
+        assert r4.warm and not r4.ctx_cached, \
+            "stale bundle must not be reused after an over-gate frame"
+        # hard scene cut: cold start, bundle recomputed -> next frame hits
+        r5 = svc.infer_session("s", dark, right, timeout=300)
+        assert r5.scene_cut and not r5.warm
+        r6 = svc.infer_session("s", dark, right, timeout=300)
+        assert r6.warm and r6.ctx_cached
+        stats = svc.close_session("s")
+        assert stats["ctx_cache_hits"] == 3
+        assert svc.metrics.ctx_cache_hits.value == 3
+    finally:
+        svc.close()
+
+
+def test_ctx_cache_http_header(tiny_model):
+    """X-Ctx-Cached rides the stream response exactly when the frame
+    reused the bundle."""
+    import io
+    import urllib.request
+
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    svc = StereoService(cfg, variables, ServeConfig(
+        max_batch=1, batch_sizes=(1,), iters=2,
+        sessions=True, session_ttl_s=600.0,
+        session_ctx_cache=True, ctx_cache_threshold=3.0))
+    server = StereoHTTPServer(svc, port=0).start()
+    try:
+        def post(sid):
+            buf = io.BytesIO()
+            np.savez(buf, left=left, right=right)
+            req = urllib.request.Request(
+                f"{server.url}/v1/stream/{sid}", data=buf.getvalue(),
+                method="POST",
+                headers={"Content-Type": "application/x-npz"})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return dict(resp.headers)
+        h0 = post("cam")
+        h1 = post("cam")
+        assert "X-Ctx-Cached" not in h0 and h0["X-Warm"] == "0"
+        assert h1.get("X-Ctx-Cached") == "1" and h1["X-Warm"] == "1"
+    finally:
+        server.shutdown()
+        svc.close()

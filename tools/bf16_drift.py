@@ -33,7 +33,6 @@ prediction drift |disp_bf16 - disp_fp32reg|.  One JSON line per row.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import sys
 import tempfile
@@ -43,12 +42,15 @@ import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
 sys.path.insert(0, _REPO)
 
 H, W = 384, 1248                  # KITTI-class, /32-aligned
 # per-band disparity ceiling (round 5: HARD layered scenes with true
-# occlusions at exactly this ceiling, not a scaled smooth ramp)
-BANDS = {"d<=48": 48.0, "d<=96": 96.0, "d<=192": 192.0}
+# occlusions at exactly this ceiling, not a scaled smooth ramp).
+# Bands + scene generator + record schema now live in tools/drift_common
+# (round 15), shared with tools/quant_drift.py so the bf16 and int8
+# rows are directly comparable.
 N_PER_BAND = 2
 ITERS = (7, 32)                   # realtime demo depth, accuracy depth
 TRAIN_STEPS = 300
@@ -56,19 +58,9 @@ TRAIN_HW = (320, 704)
 
 
 def make_band_scenes():
-    from golden_data import layered_scene
+    from drift_common import make_band_scenes as shared_scenes
 
-    rng = np.random.default_rng(11)
-    scenes = {}
-    for name, ceiling in BANDS.items():
-        rows = []
-        for _ in range(N_PER_BAND):
-            left, right, disp, _occ = layered_scene(
-                rng, H, W, d_max=ceiling, d_ceiling=ceiling)
-            rows.append((left.astype(np.float32),
-                         right.astype(np.float32), disp))
-        scenes[name] = rows
-    return scenes
+    return shared_scenes(H, W, n_per_band=N_PER_BAND, seed=11)
 
 
 def torch_seeded_pth(tmp) -> str:
@@ -136,41 +128,16 @@ def trained_variables(base_cfg):
 
 
 def evaluate(tag, cfg_variables, scenes):
-    from raft_stereo_tpu.eval.runner import InferenceRunner
+    # Shared drift harness (tools/drift_common.py): one record schema
+    # for the whole low-precision gate family.  corr_fp32_auto off: this
+    # tool MEASURES raw bf16-corr drift at deep iteration counts — the
+    # very thing the runner's guard would mask.
+    from drift_common import evaluate_variants
 
-    rows = []
-    for iters in ITERS:
-        # corr_fp32_auto off: this tool MEASURES raw bf16-corr drift at deep
-        # iteration counts — the very thing the runner's guard would mask.
-        runners = {name: InferenceRunner(cfg, variables, iters=iters,
-                                         corr_fp32_auto=False)
-                   for name, (cfg, variables) in cfg_variables.items()}
-        for band, rows_in in scenes.items():
-            preds = {name: [] for name in runners}
-            epes = {name: [] for name in runners}
-            for left, right, disp in rows_in:
-                for name, runner in runners.items():
-                    d = runner.disparity(left, right)
-                    preds[name].append(d)
-                    epes[name].append(float(np.mean(np.abs(d - disp))))
-            rec = {"metric": "bf16_corr_epe_drift", "weights": tag,
-                   "iters": iters, "band": band}
-            for name in runners:
-                rec[f"epe_{name}"] = round(float(np.mean(epes[name])), 4)
-            ref = "fp32_reg"
-            for name in runners:
-                if name != ref:
-                    rec[f"depe_{name}"] = round(
-                        rec[f"epe_{name}"] - rec[f"epe_{ref}"], 4)
-            drift = [np.abs(a - b) for a, b in
-                     zip(preds["bf16_alt"], preds[ref])]
-            rec["drift_mean_px"] = round(float(np.mean(
-                [d.mean() for d in drift])), 4)
-            rec["drift_p99_px"] = round(float(np.mean(
-                [np.percentile(d, 99) for d in drift])), 4)
-            print(json.dumps(rec))
-            rows.append(rec)
-    return rows
+    return evaluate_variants(
+        "bf16_corr_epe_drift", tag, cfg_variables, scenes,
+        iters_list=ITERS, ref="fp32_reg", drift_of="bf16_alt",
+        runner_kwargs={"corr_fp32_auto": False})
 
 
 def main():

@@ -1,0 +1,107 @@
+"""Shared drift-measurement harness for the low-precision gate tools.
+
+``tools/bf16_drift.py`` (rounds 3-5) established this repo's precision
+methodology: measure the EPE consequence of a numeric deviation
+IN-DISTRIBUTION on functioning weights, per disparity band, against a
+full-precision reference — never hand-wave from unit-level error bounds.
+Round 15's int8 tier (``tools/quant_drift.py``) extends the same gate
+down, so both tools now share this module: one scene generator and ONE
+record schema, so the bf16 and int8 numbers are directly comparable
+row for row.
+
+Record schema (one JSON object per (weights, iters, band)):
+
+    {"metric": ..., "weights": ..., "iters": N, "band": "d<=96",
+     "epe_<variant>": ...,          # per-variant mean EPE (px)
+     "depe_<variant>": ...,         # EPE delta vs the reference variant
+     "drift_mean_px": ..., "drift_p99_px": ...}   # |pred - ref pred|
+
+``drift_mean_px``/``drift_p99_px`` measure the RAW prediction deviation
+of the designated low-precision variant against the reference — the
+per-pixel story the band EPE deltas average away.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+
+# The default per-band disparity ceilings: HARD layered stereo with true
+# occlusions at exactly the ceiling (tests/golden_data.py layered_scene),
+# spanning the real evaluation range — the reference's KITTI protocol
+# clips at 192 px (evaluate_stereo.py:133-135).
+DEFAULT_BANDS = {"d<=48": 48.0, "d<=96": 96.0, "d<=192": 192.0}
+
+
+def make_band_scenes(h: int, w: int, bands: Dict[str, float] = None,
+                     n_per_band: int = 2, seed: int = 11) -> Dict:
+    """Per-band hard layered scenes: ``{band: [(left, right, disp)]}``."""
+    from golden_data import layered_scene
+
+    bands = dict(DEFAULT_BANDS if bands is None else bands)
+    rng = np.random.default_rng(seed)
+    scenes = {}
+    for name, ceiling in bands.items():
+        rows = []
+        for _ in range(n_per_band):
+            left, right, disp, _occ = layered_scene(
+                rng, h, w, d_max=ceiling, d_ceiling=ceiling)
+            rows.append((left.astype(np.float32),
+                         right.astype(np.float32), disp))
+        scenes[name] = rows
+    return scenes
+
+
+def drift_record(metric: str, weights_tag: str, iters: int, band: str,
+                 epes: Dict[str, List[float]],
+                 preds: Dict[str, List[np.ndarray]],
+                 ref: str, drift_of: str) -> dict:
+    """One schema row (module docstring): per-variant mean EPE, EPE
+    deltas vs ``ref``, and the raw prediction drift of ``drift_of``."""
+    rec = {"metric": metric, "weights": weights_tag, "iters": iters,
+           "band": band}
+    for name in epes:
+        rec[f"epe_{name}"] = round(float(np.mean(epes[name])), 4)
+    for name in epes:
+        if name != ref:
+            rec[f"depe_{name}"] = round(
+                rec[f"epe_{name}"] - rec[f"epe_{ref}"], 4)
+    drift = [np.abs(a - b) for a, b in zip(preds[drift_of], preds[ref])]
+    rec["drift_mean_px"] = round(float(np.mean(
+        [d.mean() for d in drift])), 4)
+    rec["drift_p99_px"] = round(float(np.mean(
+        [np.percentile(d, 99) for d in drift])), 4)
+    return rec
+
+
+def evaluate_variants(metric: str, weights_tag: str, cfg_variables: Dict,
+                      scenes: Dict, iters_list: Iterable[int],
+                      ref: str, drift_of: str,
+                      runner_kwargs: Dict = None) -> List[dict]:
+    """Run every (variant, iters, band) cell and emit one schema row per
+    (iters, band): ``cfg_variables`` maps variant name -> (config,
+    variables).  Prints each row as a JSON line (the bench contract) and
+    returns them all."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    runner_kwargs = dict(runner_kwargs or {})
+    rows = []
+    for iters in iters_list:
+        runners = {name: InferenceRunner(cfg, variables, iters=iters,
+                                         **runner_kwargs)
+                   for name, (cfg, variables) in cfg_variables.items()}
+        for band, rows_in in scenes.items():
+            preds = {name: [] for name in runners}
+            epes = {name: [] for name in runners}
+            for left, right, disp in rows_in:
+                for name, runner in runners.items():
+                    d = runner.disparity(left, right)
+                    preds[name].append(d)
+                    epes[name].append(float(np.mean(np.abs(d - disp))))
+            rec = drift_record(metric, weights_tag, iters, band,
+                               epes, preds, ref, drift_of)
+            print(json.dumps(rec))
+            rows.append(rec)
+    return rows
