@@ -1,0 +1,139 @@
+"""Fleet router CLI: one front door over N ``raft-serve`` replicas.
+
+    # three replicas on one host (each boots warm from the shared
+    # artifact store tools/compile_farm.py populated)
+    raft-serve --restore_ckpt ckpt --port 8551 --executable_cache_dir /shared/store ... &
+    raft-serve --restore_ckpt ckpt --port 8552 --executable_cache_dir /shared/store ... &
+    raft-serve --restore_ckpt ckpt --port 8553 --executable_cache_dir /shared/store ... &
+
+    raft-route --port 8550 \\
+        --replica http://127.0.0.1:8551 \\
+        --replica http://127.0.0.1:8552 \\
+        --replica http://127.0.0.1:8553
+
+    # clients talk to the router exactly like a single replica:
+    curl -s -X POST --data-binary @pair.npz \\
+        http://127.0.0.1:8550/v1/disparity > disp.npy
+    curl -s http://127.0.0.1:8550/fleet | python -m json.tool
+
+Stateless requests balance by measured queue depth; streaming sessions
+consistent-hash to one replica (sticky warm-start state); a dead replica
+is failed over in one health-poll interval — stateless traffic reroutes
+transparently, its sessions fail typed (410 ``session_lost``) and
+reseed cold on survivors.  See docs/architecture.md §Fleet and the
+README runbook "a replica died".
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from raft_stereo_tpu.cli import common
+
+log = logging.getLogger(__name__)
+
+
+def build_router(args):
+    from raft_stereo_tpu.serving.fleet import FleetRouter, RouterConfig
+
+    replicas = {}
+    for i, url in enumerate(args.replica):
+        name = f"r{i}"
+        if "=" in url.split("//", 1)[0]:    # "name=http://host:port"
+            name, url = url.split("=", 1)
+        replicas[name] = url
+    cfg = RouterConfig(
+        health_poll_s=args.health_poll_s,
+        health_timeout_s=args.health_timeout_s,
+        fail_after=args.fail_after,
+        request_timeout_s=args.request_timeout_s,
+        route_retries=args.route_retries,
+        fleet_brownout=args.fleet_brownout,
+        brownout_engage_fraction=args.brownout_engage_fraction,
+        brownout_restore_fraction=args.brownout_restore_fraction,
+        brownout_max_level=args.brownout_max_level)
+    return FleetRouter(replicas, cfg)
+
+
+def run_route(args) -> int:
+    from raft_stereo_tpu.serving.fleet import RouterHTTPServer
+
+    router = build_router(args).start()
+    server = RouterHTTPServer(router, host=args.host, port=args.port)
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        log.warning("signal %d: stopping the router (replicas keep "
+                    "running — they drain on their own SIGTERM)", signum)
+        stop.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _graceful)
+
+    status = router.fleet_status()
+    log.info("routing on %s over %d replica(s), %d ready: %s",
+             f"http://{args.host}:{args.port}", status["total"],
+             status["ready"],
+             {n: r["url"] for n, r in status["replicas"].items()})
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        if not stop.is_set():
+            server.shutdown()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replica", action="append", required=True,
+                   help="replica base URL (repeatable), e.g. "
+                        "http://127.0.0.1:8551 or named "
+                        "kitti0=http://10.0.0.5:8551")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8550)
+    p.add_argument("--health_poll_s", type=float, default=0.25,
+                   help="health-probe cadence per replica; the failover "
+                        "detection window is fail_after x this")
+    p.add_argument("--health_timeout_s", type=float, default=1.0,
+                   help="per-probe transport timeout (a blackholed "
+                        "health check counts as a failure after this)")
+    p.add_argument("--fail_after", type=int, default=2,
+                   help="consecutive failed probes before a replica "
+                        "leaves rotation (forwarded-traffic transport "
+                        "errors remove it immediately)")
+    p.add_argument("--request_timeout_s", type=float, default=600.0,
+                   help="forwarded-request timeout (covers first-request "
+                        "compiles on replicas without prewarm)")
+    p.add_argument("--route_retries", type=int, default=3,
+                   help="stateless dispatch attempts across distinct "
+                        "replicas before 503 no_replicas_ready "
+                        "(sessions never retry: their state is sticky)")
+    p.add_argument("--fleet_brownout",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="push a fleet-wide brownout floor to every "
+                        "replica's /admin/brownout when the AGGREGATE "
+                        "queued fraction sustains past the engage "
+                        "watermark — the fleet degrades in lockstep "
+                        "instead of flapping per replica")
+    p.add_argument("--brownout_engage_fraction", type=float, default=0.75)
+    p.add_argument("--brownout_restore_fraction", type=float,
+                   default=0.25)
+    p.add_argument("--brownout_max_level", type=int, default=2)
+    return p
+
+
+def main(argv=None):
+    common.setup_logging()
+    return run_route(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

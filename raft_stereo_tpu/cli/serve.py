@@ -9,9 +9,13 @@ serving engine (serving/engine.py).
         'http://127.0.0.1:8551/v1/disparity?format=png' > disp.png
     curl -s http://127.0.0.1:8551/metrics
 
-SIGTERM/SIGINT drain gracefully: stop admitting (new requests get 503),
-finish queued + in-flight batches, then exit — the serving mirror of the
-train loop's preemption checkpoint (training/train_loop.py).
+SIGTERM/SIGINT drain gracefully, in fleet-visible phases: /readyz flips
+to 503 first (a fleet router pulls this replica out of rotation within
+one health poll), new requests shed typed while the HTTP server stays up,
+queued + in-flight + retry-backoff work finishes via engine.drain(), and
+only then does the listener close and the process exit — the serving
+mirror of the train loop's preemption checkpoint
+(training/train_loop.py).  A second signal force-quits.
 """
 
 from __future__ import annotations
@@ -77,6 +81,8 @@ def build_service(args):
         brownout=args.brownout,
         brownout_exempt_tiers=exempt,
         executable_cache_dir=args.executable_cache_dir,
+        executable_cache_max_bytes=args.executable_cache_max_bytes,
+        executable_cache_read_only=args.executable_cache_read_only,
         sessions=args.sessions,
         session_ttl_s=args.session_ttl_s,
         session_capacity=args.session_capacity,
@@ -155,12 +161,20 @@ def run_serve(args) -> int:
         if stop.is_set():
             forced.set()  # second signal: skip the drain, hard-close
             raise KeyboardInterrupt(f"second signal {signum}: force quit")
-        log.warning("signal %d: draining (refusing new work, finishing "
-                    "%d queued requests; send again to force-quit)",
-                    signum, service.queue.depth)
+        log.warning("signal %d: graceful shutdown — /readyz flips to 503 "
+                    "(the fleet router stops routing here), new work is "
+                    "refused typed, and %d queued + in-flight + backoff "
+                    "request(s) drain before exit (send again to "
+                    "force-quit)", signum, service.queue.depth)
+        # Phase 1: leave the rotation.  The HTTP server stays UP through
+        # the whole drain — /healthz answers "draining", /readyz answers
+        # 503, and the handler threads of queued work can still write
+        # their responses.  A SIGTERM must look like a drain to the
+        # fleet, not like a crash (the pre-r16 behavior tore down the
+        # listener first, which dropped exactly the work drain() was
+        # about to finish).
+        service.begin_shutdown()
         stop.set()
-        # shutdown() unblocks serve_forever below; drain happens after.
-        threading.Thread(target=server.shutdown, daemon=True).start()
 
     if threading.current_thread() is threading.main_thread():
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -179,9 +193,13 @@ def run_serve(args) -> int:
     try:
         # serve_forever already runs on the server thread (started above
         # so readiness answered during prewarm); park the main thread on
-        # a signal-friendly join.
-        while server._thread.is_alive():
+        # a signal-friendly wait.  ``stop`` fires on the first signal
+        # with the HTTP server still up — the drain below happens WHILE
+        # the process keeps answering health probes and in-flight work.
+        while not stop.is_set() and server._thread.is_alive():
             server._thread.join(timeout=0.5)
+    except KeyboardInterrupt:
+        pass     # second signal: fall through to the forced path
     finally:
         if watchdog is not None:
             watchdog.stop()
@@ -190,11 +208,17 @@ def run_serve(args) -> int:
                         service.queue.depth)
             service.close()
         else:
+            # Phase 2: finish queued + in-flight + retry-backoff work
+            # (engine.drain waits on all three), then stop.  /readyz has
+            # been 503 since phase 1, so no router is still sending here.
             drained = service.drain(timeout=args.drain_timeout_s)
             log.info("drain %s; final metrics:\n%s",
                      "complete" if drained else
                      f"timed out after {args.drain_timeout_s:.0f}s",
                      service.metrics.render_text())
+        # Only now does the listener go away: every drained response has
+        # been written.
+        server.shutdown()
         if events is not None:
             events.close()
     return 0
@@ -306,7 +330,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "fingerprint), so a restarted server's prewarm "
                         "is disk-bound instead of compile-bound; also "
                         "enables jax's persistent compilation cache in "
-                        "the same directory")
+                        "the same directory.  May be a SHARED fleet "
+                        "artifact store (tools/compile_farm.py populates "
+                        "it once; every replica boots warm from it)")
+    p.add_argument("--executable_cache_max_bytes", type=int, default=None,
+                   help="bound the executable cache: beyond this many "
+                        "bytes the least-recently-used entries are "
+                        "evicted (atime LRU) so config / jax-version "
+                        "churn ages out instead of growing without "
+                        "bound; the serve_persist_cache_bytes gauge "
+                        "tracks the total")
+    p.add_argument("--executable_cache_read_only", action="store_true",
+                   help="treat the executable cache as a read-only "
+                        "shared artifact store: fetch warm executables "
+                        "but never write (replicas against a fleet "
+                        "store populated by tools/compile_farm.py)")
     p.add_argument("--max_dispatch_attempts", type=int, default=2,
                    help="dispatch attempts per request before the typed "
                         "RequestPoisoned failure (crashed dispatches "
@@ -381,8 +419,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "spec, e.g. 'crash=0.1,seed=7' for a 10%% "
                         "injected worker-crash rate; keys crash/oom/"
                         "compile/latency (rates), latency_ms, seed, "
-                        "max_faults, devices=0|1.  Off when unset — the "
-                        "dispatch path is bitwise-unchanged")
+                        "max_faults, devices=0|1, plus the replica-"
+                        "level faults die_after=N (kill -9 the process "
+                        "at the Nth dispatch), blackhole_after_s "
+                        "(healthz stops answering), slow_start_s "
+                        "(readiness held closed).  Off when unset — "
+                        "the dispatch path is bitwise-unchanged")
     common.add_arch_overrides(p)
     return p
 

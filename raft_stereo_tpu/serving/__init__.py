@@ -3,9 +3,11 @@ batch executables, continuous batching, admission control + backpressure,
 waste-driven bucket selection, supervised crash recovery (retries,
 per-device circuit breakers, brownout degradation, chaos testing, a
 persistent executable cache), streaming stereo sessions (warm-start video
-serving with temporal state, serving/sessions.py), and a plain-text
-metrics endpoint.  See docs/architecture.md §Serving, §Resilience, and
-§Streaming sessions."""
+serving with temporal state, serving/sessions.py), a plain-text
+metrics endpoint, and fleet-scale replication (serving/fleet/: a
+session-sticky router with failover, fleet-wide brownout, and the shared
+executable artifact store).  See docs/architecture.md §Serving,
+§Resilience, §Fleet, and §Streaming sessions."""
 
 from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
                                              Overloaded, Request,

@@ -18,6 +18,20 @@ modes the engine's supervised-recovery path (serving/engine.py) handles:
 * **compile failure** — ``jit(...).lower().compile()`` raising, the
   failure class a persistent-cache restore or an XLA upgrade can hit.
 
+Round 16 adds the REPLICA-level failure modes the fleet router
+(serving/fleet/) must survive — the unit of failure is now the whole
+process, not a worker thread:
+
+* **process death mid-dispatch** (``die_after_dispatches``) — the
+  deterministic kill -9: ``os._exit(137)`` when the Nth dispatch begins,
+  in-flight requests and open sockets and all;
+* **health-check blackhole** (``healthz_blackhole_after_s``) — /healthz
+  and /readyz stop answering while the request path keeps working, the
+  "zombie to the load balancer" mode a probe TIMEOUT must catch;
+* **slow start** (``slow_start_s``) — the readiness gate held closed
+  after boot, pinning that the router keeps a warming replica out of
+  rotation.
+
 Determinism: every injection decision is a pure function of
 ``(seed, site, worker, per-site call index)`` via SHA-256 — independent
 of thread interleaving, platform hash seeds, and wall clock.  Two runs
@@ -36,6 +50,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -91,6 +106,25 @@ class ChaosConfig:
     latency_ms: float = 0.0
     devices: Tuple[int, ...] = ()
     max_faults: Optional[int] = None
+    # ---- Replica-level faults (round 16; the fleet failover story) ----
+    # Hard-kill the WHOLE PROCESS (os._exit(137), the kill -9 exit code)
+    # when the engine's Nth dispatch begins: the replica dies
+    # mid-dispatch with requests in flight, sockets open, and no
+    # goodbye — exactly what the fleet router must survive
+    # (scripts/fleet_smoke.py).  Deterministic by construction: the Nth
+    # dispatch, not a probability.
+    die_after_dispatches: Optional[int] = None
+    # Health-check blackhole: after this many seconds of process
+    # lifetime, /healthz and /readyz stop answering (connection closed
+    # with no response) while the request path keeps working — the
+    # "zombie to the load balancer" failure the router's probe timeout
+    # must classify as dead.  0 = off.
+    healthz_blackhole_after_s: float = 0.0
+    # Slow start: the readiness gate stays closed for this many seconds
+    # after boot even once the warm ladder is compiled — models a
+    # replica fetching artifacts / weights slowly, so failover tests can
+    # pin that the router keeps it out of rotation until /readyz opens.
+    slow_start_s: float = 0.0
 
     def __post_init__(self):
         for f in ("crash_rate", "resource_exhausted_rate",
@@ -102,12 +136,26 @@ class ChaosConfig:
             raise ValueError(f"latency_ms={self.latency_ms} must be >= 0")
         if self.max_faults is not None and self.max_faults < 0:
             raise ValueError(f"max_faults={self.max_faults} must be >= 0")
+        if (self.die_after_dispatches is not None
+                and self.die_after_dispatches < 1):
+            raise ValueError(f"die_after_dispatches="
+                             f"{self.die_after_dispatches} must be >= 1")
+        if self.healthz_blackhole_after_s < 0:
+            raise ValueError(f"healthz_blackhole_after_s="
+                             f"{self.healthz_blackhole_after_s} must be "
+                             f">= 0")
+        if self.slow_start_s < 0:
+            raise ValueError(f"slow_start_s={self.slow_start_s} must be "
+                             f">= 0")
 
     @property
     def enabled(self) -> bool:
-        return any(getattr(self, f) > 0
-                   for f in ("crash_rate", "resource_exhausted_rate",
-                             "compile_failure_rate", "latency_rate"))
+        return (any(getattr(self, f) > 0
+                    for f in ("crash_rate", "resource_exhausted_rate",
+                              "compile_failure_rate", "latency_rate",
+                              "healthz_blackhole_after_s",
+                              "slow_start_s"))
+                or self.die_after_dispatches is not None)
 
 
 def _fraction(seed: int, site: str, worker: int, n: int) -> float:
@@ -130,12 +178,21 @@ class ChaosInjector:
     """
 
     def __init__(self, cfg: ChaosConfig, observe=None,
-                 sleep=time.sleep):
+                 sleep=time.sleep, clock=time.monotonic,
+                 exit_fn=None):
         self.cfg = cfg
         self.observe = observe
         self._sleep = sleep
+        self._clock = clock
+        self._t0 = clock()
+        # os._exit bypasses atexit/finally on purpose: die_after is the
+        # kill -9 simulation, and a graceful unwind would be a different
+        # (gentler) failure mode than the one under test.  Injectable for
+        # the unit tests.
+        self._exit = exit_fn if exit_fn is not None else os._exit
         self._lock = threading.Lock()
         self._counts: Dict[Tuple[str, int], int] = {}
+        self._dispatches = 0
         self.faults_injected = 0
 
     def _roll(self, site: str, worker: int) -> float:
@@ -158,13 +215,37 @@ class ChaosInjector:
             self.observe(kind)
         return True
 
+    # ------------------------------------------------ replica-level faults
+    def ready_blocked(self) -> bool:
+        """Slow start: True while the readiness gate must stay closed
+        (``slow_start_s`` of process lifetime not yet elapsed)."""
+        return (self.cfg.slow_start_s > 0
+                and self._clock() - self._t0 < self.cfg.slow_start_s)
+
+    def blackhole(self) -> bool:
+        """Health-check blackhole: True once /healthz and /readyz must
+        stop answering (the HTTP layer closes the connection with no
+        response; the router's probe timeout classifies it dead)."""
+        return (self.cfg.healthz_blackhole_after_s > 0
+                and self._clock() - self._t0
+                >= self.cfg.healthz_blackhole_after_s)
+
     # --------------------------------------------------- injection sites
     def on_dispatch(self, worker: int) -> None:
         """Called between batch pickup and the device call: may stall
-        (latency), then may raise a crash or a RESOURCE_EXHAUSTED."""
+        (latency), then may raise a crash or a RESOURCE_EXHAUSTED — or
+        hard-kill the whole process (``die_after_dispatches``)."""
         if not self._targets(worker):
             return
         c = self.cfg
+        if c.die_after_dispatches is not None:
+            with self._lock:
+                self._dispatches += 1
+                die = self._dispatches == c.die_after_dispatches
+            if die:
+                if self.observe is not None:
+                    self.observe("die")
+                self._exit(137)     # kill -9 exit code; no unwinding
         if (c.latency_rate > 0 and c.latency_ms > 0
                 and self._roll("latency", worker) < c.latency_rate
                 and self._fire("latency")):
@@ -199,6 +280,9 @@ _SPEC_FIELDS = {
     "latency": ("latency_rate", float),
     "latency_ms": ("latency_ms", float),
     "max_faults": ("max_faults", int),
+    "die_after": ("die_after_dispatches", int),
+    "blackhole_after_s": ("healthz_blackhole_after_s", float),
+    "slow_start_s": ("slow_start_s", float),
 }
 
 

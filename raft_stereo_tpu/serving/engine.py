@@ -79,6 +79,17 @@ Round 15 adds the int8 turbo tier and the per-session context cache
   run ``warm_ctx`` (the context encoder never executes); invalidated by
   scene cuts, the keyframe guard, and any frame past the
   ``ctx_cache_threshold`` static-scene gate.
+
+Round 16 makes the whole PROCESS a routine fault domain
+(serving/fleet/, docs/architecture.md §Fleet): ``begin_shutdown`` is
+the graceful-SIGTERM readiness flip the fleet router keys off,
+``set_brownout_floor`` applies the router's fleet-wide degradation
+level, the executable cache is a shareable content-addressed artifact
+store with max-bytes GC (tools/compile_farm.py populates it once for
+every replica), and a crashed dispatch carrying a SESSION frame demotes
+its requeue to a cold start + invalidates the session's warm state
+(``_invalidate_crashed_session_frame``) so no frame chains across a
+crash gap.
 """
 
 from __future__ import annotations
@@ -245,7 +256,18 @@ class ServeConfig:
     # batch, tier, executable family — warm programs have a different
     # arity — and backend fingerprint) so a restarted process prewarm
     # loads from disk instead of recompiling.  None (default) = off.
+    # The directory may be SHARED fleet-wide (an NFS mount / synced
+    # object store tools/compile_farm.py populated): keys are pure
+    # content hashes, so replicas coordinate for free.
     executable_cache_dir: Optional[str] = None
+    # Store bound: beyond this many bytes the least-recently-USED
+    # entries are evicted (atime LRU; config / jax-fingerprint churn
+    # ages out instead of growing without bound).  None = unbounded.
+    executable_cache_max_bytes: Optional[int] = None
+    # Replica role against a SHARED store: fetch warm artifacts but
+    # never write (a misconfigured replica cannot pollute the fleet's
+    # cache; the compile farm is the only writer).
+    executable_cache_read_only: bool = False
     # ---- Streaming sessions (round 14; serving/sessions.py) ------------
     # Stateful video serving: POST /v1/stream/<id> frames warm-start the
     # GRU from the session's previous low-res disparity, so with an
@@ -730,12 +752,16 @@ class ServingEngine:
                 poll_s=serve_cfg.brownout_poll_s,
                 gauge=self.metrics.brownout_level,
                 sink=_SinkRef(self)).start()
-        # Persistent executable cache (serving/persist.py).
+        # Persistent executable cache / shared artifact store
+        # (serving/persist.py).
         self.disk_cache = None
         if serve_cfg.executable_cache_dir:
             from raft_stereo_tpu.serving.persist import ExecutableDiskCache
             self.disk_cache = ExecutableDiskCache(
-                serve_cfg.executable_cache_dir)
+                serve_cfg.executable_cache_dir,
+                max_bytes=serve_cfg.executable_cache_max_bytes,
+                read_only=serve_cfg.executable_cache_read_only,
+                bytes_gauge=self.metrics.persist_cache_bytes)
         # Streaming-session store (serving/sessions.py): the per-stream
         # warm-start state behind submit_session / POST /v1/stream.  None
         # (default) keeps the engine stateless — no warm executable
@@ -771,6 +797,7 @@ class ServingEngine:
                             self._warm_target.add(
                                 (widx, (hp, wp), n, tier, family))
         self._closed = False
+        self._shutting_down = False
         self._workers_lock = threading.Lock()
         self._workers = [
             threading.Thread(target=self._worker_loop, args=(i,),
@@ -1137,7 +1164,15 @@ class ServingEngine:
         tier-family) warm entry has dispatched at least once.  True at
         boot when no ``warmup_shapes`` are configured — an engine with no
         declared warm surface is ready by definition (it just pays
-        first-request compiles, as before)."""
+        first-request compiles, as before).  False the moment a graceful
+        shutdown begins (``begin_shutdown``): the fleet router reads
+        this as "stop routing here" while queued work still drains.
+        Chaos slow-start (``ChaosConfig.slow_start_s``) also holds the
+        gate closed — the replica a failover test brings up slowly."""
+        if self._shutting_down or self._closed:
+            return False
+        if self.chaos is not None and self.chaos.ready_blocked():
+            return False
         with self._warm_lock:
             return self._warm_target <= self._warmed
 
@@ -1149,8 +1184,10 @@ class ServingEngine:
             done = len(self._warm_target & self._warmed)
             total = len(self._warm_target)
             ready = self._warm_target <= self._warmed
-        out: Dict[str, object] = {"ready": ready, "warm_done": done,
-                                  "warm_target": total}
+        out: Dict[str, object] = {"ready": ready and self.ready,
+                                  "warm_done": done,
+                                  "warm_target": total,
+                                  "draining": self._shutting_down}
         out["compiles_cold"] = self.metrics.compiles_cold.value
         out["compiles_warm"] = self.metrics.compiles_warm.value
         if self.disk_cache is not None:
@@ -1405,7 +1442,14 @@ class ServingEngine:
             self.costs.record(
                 self._cost_key(bucket, batch, cache_tier, family),
                 "serving", compile_s, compiled=compiled)
-        self.disk_cache.store(disk_key, compiled)
+        self.disk_cache.store(
+            disk_key, compiled,
+            meta={"bucket": list(bucket), "batch": int(batch),
+                  "tier": cache_tier, "family": family,
+                  "iters": self.serve_cfg.iters,
+                  "quant": self._tier_models[cache_tier].config.quant,
+                  "fetch_dtype": self.serve_cfg.fetch_dtype,
+                  "compile_s": round(compile_s, 3)})
         return compiled
 
     def _fetch_jax_dtype(self):
@@ -1522,6 +1566,8 @@ class ServingEngine:
         now_pc = time.perf_counter()
         for r in pending:
             r.attempts += 1
+            if getattr(r.payload, "session", None) is not None:
+                self._invalidate_crashed_session_frame(r)
             if r.attempts >= self.serve_cfg.max_dispatch_attempts:
                 self.metrics.poisoned.inc()
                 self.metrics.failed.inc()
@@ -1547,6 +1593,36 @@ class ServingEngine:
                     backoff_ms=round(backoff_s * 1e3, 3),
                     error=type(exc).__name__)
         self._schedule_requeue(retry, backoff_s)
+
+    def _invalidate_crashed_session_frame(self, req: Request) -> None:
+        """A crashed dispatch carried this SESSION frame (r13 requeue x
+        r14 submit_session cross): the flow this frame was supposed to
+        produce never existed, so (a) a requeued WARM frame must not
+        re-run the warm program against state the crash voided — a
+        crash *caused by* that state (NaN init, poisoned buffer) would
+        deterministically burn every retry attempt — and (b) the
+        session's stored state must not seed any LATER frame across the
+        gap.  Demote the requeued frame to the cold family (it
+        cold-starts and, on success, re-seeds the chain exactly like a
+        scene cut) and drop the session's warm-start state.  Mutating
+        the session here is safe: its ordering lock is held by THIS
+        frame from submit to resolution, so no other frame of the
+        session can observe a torn state.  The ordering lock itself is
+        released by the frame's future resolving (retry success or
+        typed poisoning) — never leaked.  Regression:
+        tests/test_sessions.py."""
+        sess = req.payload.session
+        if req.family in _WARM_FAMILIES:
+            req.family = (FAMILY_STATE_CTX
+                          if self.serve_cfg.session_ctx_cache
+                          else FAMILY_STATE)
+            req.payload.flow_init = None
+            req.payload.ctx_init = None
+            log.warning("session %s frame %s: crashed warm dispatch "
+                        "demoted to a cold start for its retry",
+                        req.session_id, req.payload.frame_index)
+        sess.flow_low = None
+        sess.ctx = None
 
     def _schedule_requeue(self, reqs: List[Request],
                           delay_s: float) -> None:
@@ -1781,6 +1857,32 @@ class ServingEngine:
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())
+
+    # ---------------------------------------------------------- fleet hooks
+    def set_brownout_floor(self, level: int) -> int:
+        """Fleet-wide degradation floor (``POST /admin/brownout``, pushed
+        by the fleet router): the engine degrades at least this many
+        rungs regardless of its local pressure signals, so the whole
+        fleet steps down in lockstep instead of each replica flapping on
+        its own queue.  Returns the effective level.  Raises
+        ``RuntimeError`` when this engine runs without a brownout
+        controller (``ServeConfig.brownout=False``)."""
+        if self.brownout is None:
+            raise RuntimeError(
+                "this engine runs without a brownout controller "
+                "(ServeConfig.brownout=False) — no ladder to degrade on")
+        return self.brownout.set_floor(level)
+
+    def begin_shutdown(self) -> None:
+        """Phase one of graceful SIGTERM (cli/serve.py): flip ``ready``
+        to False — /readyz answers 503 and the fleet router pulls this
+        replica out of rotation within one health poll — and stop
+        admitting (new submits shed with the typed draining
+        ``Overloaded``), while queued + in-flight + backoff work keeps
+        flowing and the HTTP server stays up to answer it.  ``drain()``
+        then waits that work out and ``close()``s."""
+        self._shutting_down = True
+        self.queue.stop_admitting()
 
     # -------------------------------------------------------------- shutdown
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -1,0 +1,23 @@
+"""Fleet-scale serving: replicated engines behind a session-sticky
+router with failover, fleet-wide brownout, and the shared executable
+artifact store (serving/persist.py).  See docs/architecture.md §Fleet.
+
+* ``ring``    — consistent-hash ring (session id -> replica, ~1/N remap)
+* ``replica`` — one fleet member: HTTP client + health state
+* ``router``  — routing, failover, lost-session ledger, fleet brownout
+* ``http``    — the router's HTTP front end (``raft-route``)
+"""
+
+from raft_stereo_tpu.serving.fleet.http import (RouterHTTPServer,
+                                                make_router_handler)
+from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
+                                                   ReplicaUnreachable)
+from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
+from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
+                                                  NoReplicasAvailable,
+                                                  RouterConfig, SessionLost)
+
+__all__ = ["DEFAULT_VNODES", "HashRing", "Replica", "ReplicaHealth",
+           "ReplicaUnreachable", "FleetRouter", "NoReplicasAvailable",
+           "RouterConfig", "SessionLost", "RouterHTTPServer",
+           "make_router_handler"]

@@ -1,0 +1,220 @@
+"""HTTP front end of the fleet router — the one address clients use.
+
+The request surface is the replica surface (serving/http.py): ``POST
+/v1/disparity`` and ``POST|DELETE /v1/stream/<id>`` forward verbatim —
+body bytes, query string, ``X-*`` headers, typed error bodies and all —
+to the replica the router picks, so a client cannot tell the router from
+a single engine (the pass-through-parity contract tests/test_fleet.py
+pins byte-for-byte).  On top of that the router adds its own fleet-level
+surface:
+
+* ``GET /healthz`` — router liveness + per-replica rotation summary.
+* ``GET /readyz`` — 200 once at least one replica is in rotation (the
+  fleet can answer SOMETHING), 503 otherwise; orchestrators point
+  traffic here.
+* ``GET /metrics`` — the router's own Prometheus registry
+  (``fleet_replicas_ready``, ``fleet_failovers_total``,
+  ``fleet_sessions_lost_total``, routing-decision counters).
+* ``GET /fleet`` — full JSON status: replica states, ring membership,
+  session ledger sizes, brownout level, recent transitions.
+
+Fleet-level typed errors (these are the ONLY responses the router
+originates on the request path):
+
+* 503 ``{"error": "no_replicas_ready"}`` + ``Retry-After`` — every
+  replica is dead, warming, or draining (stateless retries exhausted).
+* 410 ``{"error": "session_lost", "replica": ...}`` — this session's
+  replica left the rotation; its warm-start chain is unrecoverable.
+  Fired once per session: the client's next frame reseeds cold on a
+  surviving replica (the r14 410 contract, fleet-wide).
+
+Per-replica debug endpoints (``/debug/*``) are deliberately NOT proxied
+— they are about one process and should be hit on that process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import urlparse
+
+from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
+                                                  NoReplicasAvailable,
+                                                  SessionLost)
+from raft_stereo_tpu.serving.http import MAX_BODY_BYTES, _stream_session_id
+
+log = logging.getLogger(__name__)
+
+
+def make_router_handler(router: FleetRouter):
+    """Handler class closed over the router (instantiated per request by
+    the server, like serving/http.py's)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("%s " + fmt, self.client_address[0], *args)
+
+        # ------------------------------------------------------- responses
+        def _reply(self, code: int, body: bytes, content_type: str,
+                   extra_headers=()):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra_headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code: int, obj, extra_headers=()):
+            self._reply(code, (json.dumps(obj) + "\n").encode(),
+                        "application/json", extra_headers)
+
+        def _reply_forwarded(self, status: int,
+                             headers: List[Tuple[str, str]],
+                             body: bytes):
+            """Relay a replica response verbatim: the replica's own
+            header set (hop-by-hop stripped by Replica.forward) plus a
+            recomputed Content-Length — no router fingerprints on the
+            pass-through path."""
+            self.send_response(status)
+            have_length = False
+            for k, v in headers:
+                if k.lower() == "content-length":
+                    have_length = True
+                self.send_header(k, v)
+            if not have_length:
+                self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # ---------------------------------------------------------- routes
+        def _forward(self, method: str, body: Optional[bytes]):
+            url = urlparse(self.path)
+            path_qs = url.path + (f"?{url.query}" if url.query else "")
+            headers = list(self.headers.items())
+            session_id = _stream_session_id(url.path, self.headers)
+            try:
+                if session_id is not None:
+                    if session_id == "":
+                        self._reply_json(400, {
+                            "error": "stream requests need a session "
+                                     "id: /v1/stream/<id> or "
+                                     "X-Session-Id"})
+                        return
+                    status, h, payload = router.forward_session(
+                        session_id, method, path_qs, body, headers)
+                else:
+                    status, h, payload = router.forward_stateless(
+                        method, path_qs, body, headers)
+            except SessionLost as e:
+                self._reply_json(410, {
+                    "error": "session_lost",
+                    "session_id": e.session_id,
+                    "replica": e.replica,
+                    "detail": str(e)})
+                return
+            except NoReplicasAvailable as e:
+                self._reply_json(
+                    503, {"error": "no_replicas_ready",
+                          "retry_after_s": 1.0, "detail": str(e)},
+                    extra_headers=[("Retry-After", "1")])
+                return
+            self._reply_forwarded(status, h, payload)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            path = url.path
+            if path == "/metrics":
+                self._reply(200, router.registry.render_text().encode(),
+                            "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                status = router.fleet_status()
+                self._reply_json(200, {
+                    "status": "ok",
+                    "ready_replicas": status["ready"],
+                    "total_replicas": status["total"],
+                    "in_rotation": status["in_rotation"],
+                    "brownout_level": status["brownout_level"],
+                    "sessions_routed": status["sessions_routed"]})
+            elif path == "/readyz":
+                status = router.fleet_status()
+                ready = status["ready"] > 0
+                self._reply_json(200 if ready else 503, {
+                    "status": "ready" if ready else "no_replicas",
+                    "ready": ready,
+                    "ready_replicas": status["ready"],
+                    "total_replicas": status["total"]})
+            elif path == "/fleet":
+                self._reply_json(200, router.fleet_status())
+            else:
+                self._reply_json(404, {"error": f"no route {path!r}"})
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            if (url.path != "/v1/disparity"
+                    and _stream_session_id(url.path, self.headers)
+                    is None):
+                self._reply_json(404, {"error": f"no route {url.path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if not 0 < length <= MAX_BODY_BYTES:
+                    raise ValueError(
+                        f"Content-Length {length} out of range")
+                body = self.rfile.read(length)
+            except (ValueError, OSError) as e:
+                self._reply_json(400, {"error": str(e)})
+                return
+            self._forward("POST", body)
+
+        def do_DELETE(self):
+            if _stream_session_id(urlparse(self.path).path,
+                                  self.headers) is None:
+                self._reply_json(404,
+                                 {"error": f"no route {self.path!r}"})
+                return
+            self._forward("DELETE", None)
+
+    return Handler
+
+
+class RouterHTTPServer:
+    """Owns the router's ThreadingHTTPServer; same lifecycle surface as
+    serving/http.StereoHTTPServer (``port=0`` for tests, ``start`` for a
+    daemon thread, ``serve_forever`` for the CLI)."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 8550):
+        self.router = router
+        self.server = ThreadingHTTPServer((host, port),
+                                          make_router_handler(router))
+        self._thread = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def serve_forever(self):
+        self.server.serve_forever()
+
+    def start(self) -> "RouterHTTPServer":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="fleet-http")
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

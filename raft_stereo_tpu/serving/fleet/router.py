@@ -1,0 +1,546 @@
+"""The fleet router: N replica engines behind one front door.
+
+One serving process is now crash-safe *internally* (round 13: supervised
+recovery, breakers, brownout) — but the process itself is still a single
+fault domain: a kill takes every in-flight request, every streaming
+session, and a compile storm with it.  This module makes the REPLICA the
+unit of failure:
+
+* **Routing.**  Stateless ``/v1/disparity`` requests go to the
+  least-loaded ready replica (queue depth, then inflight, from the last
+  health probe; round-robin among equals).  Streaming ``/v1/stream/<id>``
+  requests are STICKY: the session id consistent-hashes onto the ring of
+  in-rotation replicas (fleet/ring.py), so every frame of one session
+  lands on the engine holding its warm-start state, and replica loss
+  remaps only ~1/N of the id space — the sessions that died with it.
+* **Failover.**  A transport failure (connection refused/reset/timeout)
+  on a forwarded request or ``fail_after`` consecutive health-probe
+  failures takes the replica out of rotation immediately.  Stateless
+  requests retry on the next replica — a disparity request is a pure
+  function of its inputs, so the retry is safe and the client never sees
+  the death.  The lost replica's sessions CANNOT fail over (their state
+  is gone): each one fails typed with ``SessionLost`` (HTTP 410
+  ``session_lost``) exactly once, then the id is forgotten so the
+  client's reseed — its next frame, cold — routes to a surviving replica
+  and starts a fresh chain.  The r14 tombstone contract, fleet-wide: a
+  broken stream is always announced, never silently restarted.
+* **Fleet brownout.**  Sustained aggregate queue pressure across the
+  ready replicas raises one fleet-wide degradation level (hysteresis as
+  in serving/resilience.py) and pushes it to every replica's
+  ``POST /admin/brownout`` floor — the whole fleet degrades in lockstep
+  instead of each replica flapping on its own local signal.
+* **Recovery.**  A probe succeeding on a dead replica puts it back in
+  rotation (and re-pushes the current brownout floor).  With the shared
+  executable artifact store (serving/persist.py) a replacement replica
+  boots warm, so rejoin cost is an artifact fetch, not a compile storm.
+
+Pass-through contract: with every replica healthy the router adds no
+behavior — request and response bytes are forwarded verbatim (hop-by-hop
+headers aside), so a one-replica fleet is byte-identical to hitting the
+engine directly (the bitwise solo-parity chain now extends client ->
+router -> replica -> engine -> solo).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
+                                                   ReplicaUnreachable)
+from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
+from raft_stereo_tpu.telemetry.registry import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+class NoReplicasAvailable(RuntimeError):
+    """No ready replica can take this request right now (the fleet's
+    503: every member is dead, warming, or draining)."""
+
+
+class SessionLost(KeyError):
+    """Typed fleet-level dead-session failure (HTTP 410
+    ``session_lost``): the replica holding this session's warm-start
+    state left the rotation, so the chain is unrecoverable.  Fired once
+    per session; the client's next frame reseeds cold on a surviving
+    replica."""
+
+    def __init__(self, session_id: str, replica: str):
+        super().__init__(
+            f"session {session_id!r} lost with replica {replica!r}; "
+            f"reseed on the next frame (it will cold-start)")
+        self.session_id = session_id
+        self.replica = replica
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Fleet-router knobs (cli/route.py maps flags here)."""
+
+    health_poll_s: float = 0.25      # probe cadence per replica
+    health_timeout_s: float = 1.0    # per-probe transport timeout
+    # Consecutive failed PROBES before a replica is declared dead.  A
+    # transport failure on real forwarded traffic kills it immediately
+    # (stronger signal — a request already burned on it).
+    fail_after: int = 2
+    request_timeout_s: float = 600.0  # forwarded-request timeout (covers
+    #                                   a first-request compile on a
+    #                                   replica without prewarm)
+    # Total stateless dispatch attempts across distinct replicas before
+    # the router gives up with NoReplicasAvailable.
+    route_retries: int = 3
+    vnodes: int = DEFAULT_VNODES
+    # Fleet-wide brownout: aggregate queued fraction (sum of ready
+    # replicas' queue depths / sum of their limits) above the engage
+    # watermark for engage_s raises the fleet level one rung; below the
+    # restore watermark for restore_s lowers it.  Same hysteresis shape
+    # as the per-engine BrownoutController, driven by the fleet signal.
+    fleet_brownout: bool = True
+    brownout_engage_fraction: float = 0.75
+    brownout_engage_s: float = 0.5
+    brownout_restore_fraction: float = 0.25
+    brownout_restore_s: float = 2.0
+    brownout_max_level: int = 2
+    # Lost-session bookkeeping bound: ids older than this are forgotten
+    # even if the client never came back for its 410.
+    session_lost_ttl_s: float = 60.0
+
+    def __post_init__(self):
+        if self.fail_after < 1:
+            raise ValueError(f"fail_after={self.fail_after} must be >= 1")
+        if self.route_retries < 1:
+            raise ValueError(
+                f"route_retries={self.route_retries} must be >= 1")
+        if not (0 < self.brownout_restore_fraction
+                <= self.brownout_engage_fraction <= 1):
+            raise ValueError(
+                f"need 0 < brownout_restore_fraction "
+                f"({self.brownout_restore_fraction}) <= "
+                f"brownout_engage_fraction "
+                f"({self.brownout_engage_fraction}) <= 1")
+
+
+class FleetRouter:
+    """Routing brain over a set of ``Replica`` clients.
+
+    ``replicas`` maps name -> base URL.  ``start()`` runs one synchronous
+    probe pass (so routing works immediately) and then the background
+    health loop; ``stop()`` joins it.  All routing state (ring
+    membership, session table, lost set, brownout level) is guarded by
+    one lock — routing decisions are cheap; the forwarding I/O happens
+    outside it.
+    """
+
+    def __init__(self, replicas: Dict[str, str],
+                 cfg: RouterConfig = RouterConfig(),
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.cfg = cfg
+        self._clock = clock
+        self.replicas: Dict[str, Replica] = {
+            name: Replica(name, url) for name, url in replicas.items()}
+        self._lock = threading.Lock()
+        # Ring membership == replicas currently IN ROTATION (alive and
+        # ready).  Sessions route over this ring only.
+        self.ring = HashRing(vnodes=cfg.vnodes)
+        # sid -> replica name, for every session the router has routed;
+        # the blast-radius ledger a replica death consults.
+        self._session_table: Dict[str, str] = {}
+        # sid -> (replica, t_lost): sessions owed one typed 410.
+        self._lost: "OrderedDict[str, Tuple[str, float]]" = OrderedDict()
+        self._rr = 0                       # round-robin tiebreak
+        self._transitions: List[Dict[str, object]] = []   # audit trail
+        # Fleet brownout state.
+        self.brownout_level = 0
+        self._pressure_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # ---- metrics ----------------------------------------------------
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.replicas_ready = r.gauge(
+            "fleet_replicas_ready",
+            "replicas currently in rotation (alive and ready)")
+        self.replicas_total = r.gauge(
+            "fleet_replicas_total", "replicas configured in the fleet")
+        self.replicas_total.set(len(self.replicas))
+        self.failovers = r.counter(
+            "fleet_failovers_total",
+            "replicas removed from rotation after transport failures "
+            "(health probes or forwarded traffic)")
+        self.sessions_lost = r.counter(
+            "fleet_sessions_lost_total",
+            "streaming sessions failed typed (410 session_lost) because "
+            "their replica left the rotation")
+        self.route_retries = r.counter(
+            "fleet_route_retries_total",
+            "stateless requests re-dispatched to another replica after "
+            "a transport failure (the zero-loss failover path)")
+        self.unroutable = r.counter(
+            "fleet_requests_unroutable_total",
+            "requests failed with no_replicas_ready (every fleet member "
+            "dead, warming, or draining)")
+        self.brownout_gauge = r.gauge(
+            "fleet_brownout_level",
+            "fleet-wide brownout degradation level pushed to every "
+            "replica's /admin/brownout floor (0 = off)")
+        self.brownout_pushes = r.counter(
+            "fleet_brownout_pushes_total",
+            "brownout floor updates pushed to replicas")
+        self._routed_lock = threading.Lock()
+        self._routed_by_kind: Dict[str, object] = {}
+        self._per_replica_lock = threading.Lock()
+        self._routed_by_replica: Dict[str, object] = {}
+
+    # ---------------------------------------------------------------- metrics
+    def _note_routed(self, kind: str, replica: str) -> None:
+        with self._routed_lock:
+            c = self._routed_by_kind.get(kind)
+            if c is None:
+                c = self.registry.counter(
+                    "fleet_requests_routed_total",
+                    "requests routed to a replica, by routing kind",
+                    labels={"kind": kind})
+                self._routed_by_kind[kind] = c
+        c.inc()
+        with self._per_replica_lock:
+            c = self._routed_by_replica.get(replica)
+            if c is None:
+                c = self.registry.counter(
+                    "fleet_replica_routed_total",
+                    "requests routed per replica",
+                    labels={"replica": replica})
+                self._routed_by_replica[replica] = c
+        c.inc()
+
+    def routed(self, kind: str) -> int:
+        with self._routed_lock:
+            c = self._routed_by_kind.get(kind)
+        return 0 if c is None else c.value
+
+    # ----------------------------------------------------------- health loop
+    def start(self) -> "FleetRouter":
+        self.check_replicas()        # synchronous first pass: routable now
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-health")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.health_poll_s):
+            try:
+                self.check_replicas()
+            except Exception:  # pragma: no cover — loop must not die
+                log.exception("fleet health poll failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def check_replicas(self) -> None:
+        """One probe pass over every replica (public: tests and the
+        smoke call it directly for deterministic stepping).  Probes run
+        OUTSIDE the lock; state transitions apply under it."""
+        results: Dict[str, Optional[ReplicaHealth]] = {}
+        for name, rep in self.replicas.items():
+            try:
+                results[name] = rep.probe(self.cfg.health_timeout_s)
+            except ReplicaUnreachable:
+                results[name] = None
+        with self._lock:
+            for name, health in results.items():
+                rep = self.replicas[name]
+                if health is None:
+                    rep.consecutive_failures += 1
+                    if (rep.alive
+                            and rep.consecutive_failures
+                            >= self.cfg.fail_after):
+                        self._remove_from_rotation_locked(
+                            rep, "health_probe_failures")
+                    continue
+                rep.consecutive_failures = 0
+                rep.health = health
+                was_dead = not rep.alive
+                rep.alive = True
+                in_ring = rep.name in self.ring
+                if health.ready and not in_ring:
+                    self.ring.add(rep.name)
+                    self._transitions.append({
+                        "t": self._clock(), "replica": rep.name,
+                        "event": ("rejoined" if was_dead else "ready")})
+                    log.info("replica %s in rotation (%d/%d ready)",
+                             rep.name, len(self.ring),
+                             len(self.replicas))
+                    if self.brownout_level > 0:
+                        self._push_brownout_locked((rep,))
+                elif not health.ready and in_ring:
+                    self._remove_from_rotation_locked(
+                        rep, "draining" if health.draining
+                        else "not_ready", dead=False)
+            self._note_ready_locked()
+        self._brownout_poll()
+
+    def _note_ready_locked(self) -> None:
+        self.replicas_ready.set(len(self.ring))
+
+    def _remove_from_rotation_locked(self, rep: Replica, reason: str,
+                                     dead: bool = True) -> None:
+        """Take one replica out of rotation: ring membership drops (only
+        ~1/N of session slots remap), its sessions become typed losses,
+        and — when ``dead`` — it stays out until a probe succeeds."""
+        if dead:
+            rep.alive = False
+        if rep.name not in self.ring and not dead:
+            return
+        self.ring.remove(rep.name)
+        now = self._clock()
+        lost = [sid for sid, owner in self._session_table.items()
+                if owner == rep.name]
+        for sid in lost:
+            del self._session_table[sid]
+            self._lost[sid] = (rep.name, now)
+            self._lost.move_to_end(sid)
+        self.sessions_lost.inc(len(lost))
+        self.failovers.inc()
+        self._transitions.append({
+            "t": now, "replica": rep.name, "event": "removed",
+            "reason": reason, "sessions_lost": len(lost)})
+        self._note_ready_locked()
+        log.warning("replica %s out of rotation (%s): %d session(s) "
+                    "lost, %d/%d replicas ready", rep.name, reason,
+                    len(lost), len(self.ring), len(self.replicas))
+
+    def _expire_lost_locked(self, now: float) -> None:
+        while self._lost:
+            sid, (_rep, t) = next(iter(self._lost.items()))
+            if now - t <= self.cfg.session_lost_ttl_s:
+                break
+            del self._lost[sid]
+
+    # -------------------------------------------------------------- routing
+    def _ready_replicas_locked(self) -> List[Replica]:
+        return [r for r in self.replicas.values() if r.ready]
+
+    def pick_stateless(self, exclude: Sequence[str] = ()) -> Replica:
+        """Least-loaded ready replica (queue depth, then inflight, from
+        the last probe), round-robin among equals; raises
+        ``NoReplicasAvailable`` when the rotation is empty."""
+        with self._lock:
+            ready = [r for r in self._ready_replicas_locked()
+                     if r.name not in exclude]
+            if not ready:
+                raise NoReplicasAvailable(
+                    f"no ready replica (fleet of {len(self.replicas)}; "
+                    f"excluded {sorted(exclude)})")
+            key = lambda r: (r.health.load if r.health else (0, 0))
+            best = min(key(r) for r in ready)
+            tied = [r for r in ready if key(r) == best]
+            self._rr += 1
+            return tied[self._rr % len(tied)]
+
+    def pick_session(self, session_id: str) -> Replica:
+        """The ring's replica for this session id; raises ``SessionLost``
+        (once) for ids whose replica left the rotation, and
+        ``NoReplicasAvailable`` on an empty rotation."""
+        with self._lock:
+            self._expire_lost_locked(self._clock())
+            entry = self._lost.pop(session_id, None)
+            if entry is not None:
+                # Fire-once: the id is forgotten now, so the client's
+                # reseed (the next frame on this or a fresh id) routes
+                # normally and cold-starts on a surviving replica.
+                raise SessionLost(session_id, entry[0])
+            name = self.ring.lookup(session_id)
+            if name is None:
+                raise NoReplicasAvailable(
+                    "no ready replica to own this session")
+            rep = self.replicas[name]
+            self._session_table[session_id] = name
+            return rep
+
+    def forget_session(self, session_id: str) -> None:
+        """Drop a session from the routing ledger (its replica answered
+        a close, a 410, or the stream ended)."""
+        with self._lock:
+            self._session_table.pop(session_id, None)
+
+    def note_transport_failure(self, rep: Replica) -> None:
+        """A forwarded request hit a transport error on ``rep``: out of
+        rotation immediately (a burned request outranks ``fail_after``
+        probe patience); the health loop will re-admit it when it
+        answers probes again."""
+        with self._lock:
+            if rep.alive or rep.name in self.ring:
+                self._remove_from_rotation_locked(rep, "transport_error")
+
+    # ----------------------------------------------------------- forwarding
+    def forward_stateless(self, method: str, path_qs: str,
+                          body: Optional[bytes],
+                          headers: Sequence[Tuple[str, str]]
+                          ) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """Forward one stateless request with transport-level failover:
+        a replica that dies mid-request burns one attempt, the request
+        re-dispatches to the next ready replica (inference is a pure
+        function of the request body — the retry is safe), and only
+        ``route_retries`` exhausted or an empty rotation surfaces as an
+        error.  HTTP error responses are answers, not failures — they
+        forward verbatim, no retry."""
+        tried: List[str] = []
+        last: Optional[ReplicaUnreachable] = None
+        for attempt in range(self.cfg.route_retries):
+            try:
+                rep = self.pick_stateless(exclude=tried)
+            except NoReplicasAvailable:
+                if last is None:
+                    self.unroutable.inc()
+                    raise
+                break
+            tried.append(rep.name)
+            if attempt > 0:
+                self.route_retries.inc()
+            try:
+                status, h, payload = rep.forward(
+                    method, path_qs, body, headers,
+                    self.cfg.request_timeout_s)
+            except ReplicaUnreachable as e:
+                last = e
+                self.note_transport_failure(rep)
+                log.warning("stateless %s %s: replica %s died "
+                            "mid-request (attempt %d); failing over",
+                            method, path_qs, rep.name, attempt + 1)
+                continue
+            self._note_routed("stateless", rep.name)
+            return status, h, payload
+        self.unroutable.inc()
+        raise NoReplicasAvailable(
+            f"all {len(tried)} dispatch attempt(s) hit transport "
+            f"failures (tried {tried}): {last}")
+
+    def forward_session(self, session_id: str, method: str, path_qs: str,
+                        body: Optional[bytes],
+                        headers: Sequence[Tuple[str, str]]
+                        ) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """Forward one session-sticky request.  No transport failover:
+        the session's state lives on exactly one replica, so a transport
+        failure there IS the loss of the session — the replica leaves
+        the rotation and this request (and only this one) fails typed
+        with ``SessionLost``."""
+        rep = self.pick_session(session_id)   # SessionLost / NoReplicas
+        try:
+            status, h, payload = rep.forward(
+                method, path_qs, body, headers,
+                self.cfg.request_timeout_s)
+        except ReplicaUnreachable:
+            self.note_transport_failure(rep)
+            with self._lock:
+                # pick_session recorded the route; the death path above
+                # may have tombstoned it already — pop either way so the
+                # 410 fires exactly once, right now.
+                self._session_table.pop(session_id, None)
+                self._lost.pop(session_id, None)
+            raise SessionLost(session_id, rep.name) from None
+        self._note_routed("session", rep.name)
+        if status == 410 or (method == "DELETE" and status == 200):
+            self.forget_session(session_id)
+        return status, h, payload
+
+    # -------------------------------------------------------- fleet brownout
+    def _fleet_pressure_locked(self) -> Optional[float]:
+        """Aggregate queued fraction across ready replicas; None when no
+        replica reports a queue limit (nothing to measure)."""
+        depth = limit = 0
+        for rep in self._ready_replicas_locked():
+            if rep.health is None or rep.health.queue_limit <= 0:
+                continue
+            depth += rep.health.queue_depth
+            limit += rep.health.queue_limit
+        if limit <= 0:
+            return None
+        return depth / limit
+
+    def _brownout_poll(self) -> None:
+        if not self.cfg.fleet_brownout:
+            return
+        now = self._clock()
+        push: Optional[Tuple[Replica, ...]] = None
+        with self._lock:
+            pressure = self._fleet_pressure_locked()
+            if pressure is None:
+                return
+            level = self.brownout_level
+            if pressure >= self.cfg.brownout_engage_fraction:
+                self._calm_since = None
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                elif (now - self._pressure_since
+                        >= self.cfg.brownout_engage_s
+                        and level < self.cfg.brownout_max_level):
+                    self.brownout_level = level + 1
+                    self._pressure_since = now
+                    push = tuple(r for r in self.replicas.values()
+                                 if r.alive)
+            elif pressure <= self.cfg.brownout_restore_fraction:
+                self._pressure_since = None
+                if level > 0:
+                    if self._calm_since is None:
+                        self._calm_since = now
+                    elif (now - self._calm_since
+                            >= self.cfg.brownout_restore_s):
+                        self.brownout_level = level - 1
+                        self._calm_since = now
+                        push = tuple(r for r in self.replicas.values()
+                                     if r.alive)
+                else:
+                    self._calm_since = None
+            else:
+                self._pressure_since = None
+                self._calm_since = None
+            if push is not None:
+                new_level = self.brownout_level
+                self.brownout_gauge.set(new_level)
+                log.warning("fleet brownout level %d -> %d (aggregate "
+                            "queued fraction %.2f)", level, new_level,
+                            pressure)
+        if push is not None:
+            self._push_brownout(push)
+
+    def _push_brownout(self, reps: Sequence[Replica]) -> None:
+        for rep in reps:
+            try:
+                if rep.post_brownout(self.brownout_level,
+                                     self.cfg.health_timeout_s):
+                    self.brownout_pushes.inc()
+            except ReplicaUnreachable:
+                pass    # the health loop will notice and re-push on rejoin
+
+    def _push_brownout_locked(self, reps: Sequence[Replica]) -> None:
+        """Re-push the current floor to a rejoining replica — fired from
+        inside the lock; the actual I/O rides a short-lived thread so
+        the probe pass is never blocked on a slow member."""
+        threading.Thread(
+            target=lambda: self._push_brownout(reps),
+            daemon=True, name="fleet-brownout-push").start()
+
+    # --------------------------------------------------------------- status
+    def fleet_status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "replicas": {name: rep.stats()
+                             for name, rep in self.replicas.items()},
+                "in_rotation": list(self.ring.members),
+                "ready": len(self.ring),
+                "total": len(self.replicas),
+                "sessions_routed": len(self._session_table),
+                "sessions_pending_loss": len(self._lost),
+                "brownout_level": self.brownout_level,
+                "transitions": list(self._transitions[-50:]),
+            }

@@ -58,6 +58,11 @@ Endpoints:
   from the persistent executable cache); 503 with warm progress before
   that.  Pointing traffic here keeps cold pods out of rotation while
   they prewarm (docs/architecture.md §Resilience).
+* ``POST /admin/brownout`` — fleet control plane (serving/fleet/):
+  ``{"level": N}`` sets the brownout degradation FLOOR the router
+  computed from aggregate fleet pressure, so every replica steps down
+  the tier ladder in lockstep; 200 with the effective level, 409
+  ``brownout_unavailable`` without a brownout controller.
 * ``POST /debug/trace`` — bounded on-demand profiler window on the live
   serving process (telemetry/trace.py); optional JSON body
   ``{"duration_ms": N}``; replies with the trace directory, 409 while a
@@ -178,6 +183,16 @@ def make_handler(service: StereoService,
         def do_GET(self):
             url = urlparse(self.path)
             path = url.path
+            if (path in ("/healthz", "/readyz")
+                    and service.chaos is not None
+                    and service.chaos.blackhole()):
+                # Injected health-check blackhole (serving/chaos.py
+                # healthz_blackhole_after_s): the probe's connection
+                # closes with no response — the router's probe timeout
+                # must classify this replica dead even though its
+                # request path still works.
+                self.close_connection = True
+                return
             if path == "/metrics":
                 self._reply(200, service.metrics.render_text().encode(),
                             "text/plain; version=0.0.4")
@@ -185,12 +200,15 @@ def make_handler(service: StereoService,
                 # Liveness: answers as long as the process is up; the
                 # readiness decision lives on /readyz (split so a warm
                 # restart is not health-flapped out of existence while
-                # it prewarms).
+                # it prewarms).  queue_depth/queue_limit/inflight are
+                # the load signals the fleet router balances and
+                # aggregates brownout pressure on.
                 self._reply_json(200, {
                     "status": ("draining" if service.queue.draining
                                else "ok"),
                     "ready": service.ready,
                     "queue_depth": service.queue.depth,
+                    "queue_limit": service.serve_cfg.max_queue,
                     "inflight": service.metrics.inflight.value,
                     "last_batch_age_s":
                         service.metrics.last_batch_age_s(),
@@ -214,8 +232,36 @@ def make_handler(service: StereoService,
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
 
+        def _handle_brownout_post(self):
+            """``POST /admin/brownout {"level": N}`` — the fleet-wide
+            degradation floor the router pushes (serving/fleet/router.py)
+            so every replica steps down the tier ladder in lockstep.
+            200 with the effective level; 409 ``brownout_unavailable``
+            when this engine runs without a brownout controller."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length \
+                    else {}
+                level = int(body["level"])
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply_json(400, {
+                    "error": 'need a JSON body {"level": N}',
+                    "detail": str(e)})
+                return
+            try:
+                effective = service.set_brownout_floor(level)
+            except RuntimeError as e:
+                self._reply_json(409, {"error": "brownout_unavailable",
+                                       "detail": str(e)})
+                return
+            self._reply_json(200, {"status": "ok", "floor": level,
+                                   "level": effective})
+
         def do_POST(self):
             url = urlparse(self.path)
+            if url.path == "/admin/brownout":
+                self._handle_brownout_post()
+                return
             if url.path == "/debug/trace":
                 handle_trace_post(self, trace, self._reply_json)
                 return

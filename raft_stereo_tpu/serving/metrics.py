@@ -124,6 +124,10 @@ class ServingMetrics:
             "serve_compiles_warm_total",
             "serving executables restored from the persistent disk "
             "cache (warm — no XLA compile paid)")
+        self.persist_cache_bytes = r.gauge(
+            "serve_persist_cache_bytes",
+            "bytes of serialized executables in the persistent artifact "
+            "store (post-GC; 0 without executable_cache_dir)")
         self._circuit_lock = threading.Lock()
         self._circuit_by_device: Dict[int, Gauge] = {}
         self._chaos_lock = threading.Lock()

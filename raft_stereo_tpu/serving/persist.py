@@ -1,25 +1,47 @@
-"""Persistent AOT executable cache: restart-to-ready in seconds, not
-compile-minutes.
+"""Shared content-addressed executable artifact store: restart-to-ready
+(and fleet scale-out) in seconds, not compile-minutes.
 
 COST_REPORT_r10.json measured 23.6 s of XLA compile for the 7-iter
-realtime model *per shape bucket* — and round 11/12 multiplied the
-executable surface to (bucket x batch size x tier).  A crashed or
-rescheduled serving process repays that entire product on boot, which at
-production scale means tens of seconds of dead pod per autoscale event.
-This module makes prewarm disk-bound instead of compile-bound:
+realtime model *per shape bucket* — and rounds 11/12/14/15 multiplied
+the executable surface to (bucket x batch x tier x family).  A crashed,
+rescheduled, or newly scaled-out serving replica repays that entire
+product on boot, which at production scale means tens of seconds of dead
+pod per autoscale event — times N replicas.  This module makes prewarm
+fetch-bound instead of compile-bound:
 
-* ``ExecutableDiskCache`` — serializes compiled executables
-  (``jax.experimental.serialize_executable``) to a content-addressed
-  file per compile point and loads them back on the next boot.  The key
-  is a SHA-256 over everything that invalidates an executable: jax
+* ``ExecutableDiskCache`` — a content-addressed store of serialized
+  compiled executables (``jax.experimental.serialize_executable``).  The
+  key is a SHA-256 over everything that invalidates an executable: jax
   version, backend platform + version, device kind, the model config
   JSON, padded shape, batch size, tier knobs, GRU depth, fetch dtype,
-  donation, and the executable FAMILY / flow_init arity (the round-14
-  warm-start programs take an extra traced input and return the low-res
-  state, so warm and cold variants of one (config, shape, batch, tier)
-  must never collide on one key — engine._disk_key passes both
-  coordinates) — a new jax wheel or a config change misses cleanly and
-  recompiles (stale entries are just dead files, never wrong programs).
+  donation, quant mode, and the executable FAMILY / flow_init arity —
+  a new jax wheel or a config change misses cleanly and recompiles
+  (stale entries are dead files, never wrong programs).
+
+  **Layout** (the fleet contract, docs/architecture.md §Fleet): entries
+  live at ``<store>/<key[:2]>/<key>.jaxexe`` with an optional
+  ``<key>.json`` manifest sidecar recording the human-readable compile
+  coordinates — a flat SHA-256-addressed tree any shared medium can
+  carry (NFS mount, object-store sync, an image layer baked by
+  tools/compile_farm.py).  Round-13 flat-layout entries
+  (``<store>/<key>.jaxexe``) still load.  Because keys are pure content
+  hashes, concurrent writers (N replicas, a compile farm) can share one
+  directory with no coordination: identical coordinates produce
+  identical keys, and the atomic rename makes the last writer win with
+  an equivalent artifact.
+
+  **Shared-store roles**: a compile farm populates the store
+  (read-write); replicas may mount it ``read_only`` — they fetch warm
+  artifacts but never write, so a misconfigured replica cannot pollute
+  the fleet's shared cache.
+
+  **Garbage collection**: ``max_bytes`` bounds the store.  Entries are
+  evicted least-recently-USED first (atime, which ``load`` refreshes
+  explicitly via ``os.utime`` so noatime mounts still track use);
+  config / jax-fingerprint churn therefore ages out instead of growing
+  without bound.  The ``bytes_gauge`` hook keeps the
+  ``serve_persist_cache_bytes`` gauge live.
+
 * ``enable_persistent_compilation_cache`` — turns on jax's own
   persistent compilation cache in the same directory, which also covers
   compiles that do not route through the AOT path.
@@ -27,10 +49,10 @@ This module makes prewarm disk-bound instead of compile-bound:
 Degradation contract (same as telemetry/costs.py): serialization that
 fails for any reason — backend without serialization support, pickle
 drift across versions, a corrupt/truncated cache file — logs once and
-falls back to a fresh compile.  The cache can make boot faster; it can
+falls back to a fresh compile.  The store can make boot faster; it can
 never make serving wrong or down.  Writes are atomic (tmp +
 ``os.replace``) so a crash mid-write cannot leave a torn entry for the
-next boot to trip over.
+next boot (or another replica) to trip over.
 """
 
 from __future__ import annotations
@@ -41,12 +63,15 @@ import logging
 import os
 import pickle
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
 # Bump to invalidate every existing cache entry on a format change.
 CACHE_FORMAT_VERSION = 1
+
+ENTRY_SUFFIX = ".jaxexe"
+MANIFEST_SUFFIX = ".json"
 
 
 def backend_fingerprint() -> Dict[str, str]:
@@ -82,32 +107,90 @@ def executable_cache_key(**coords: Any) -> str:
 
 
 class ExecutableDiskCache:
-    """Directory of serialized compiled executables, keyed by
-    ``executable_cache_key``.
+    """Content-addressed store of serialized compiled executables, keyed
+    by ``executable_cache_key``.
 
     ``load`` returns a ready-to-call loaded executable or None (miss /
     unreadable / wrong format — misses never raise).  ``store`` is
-    best-effort and atomic.  A ``disabled`` cache (serialization proved
-    unavailable on this backend) stops trying after the first failure so
-    a hot dispatch path does not repeatedly pay a doomed serialize.
+    best-effort and atomic, a no-op in ``read_only`` mode.  A
+    ``disabled`` cache (serialization proved unavailable on this
+    backend) stops trying after the first failure so a hot dispatch path
+    does not repeatedly pay a doomed serialize.  ``max_bytes`` bounds
+    the store with LRU-by-atime eviction; ``bytes_gauge`` (any object
+    with ``set``) tracks the post-GC total.
     """
 
-    def __init__(self, cache_dir: str):
+    def __init__(self, cache_dir: str, max_bytes: Optional[int] = None,
+                 read_only: bool = False, bytes_gauge=None):
+        if max_bytes is not None and max_bytes < 0:
+            raise ValueError(f"max_bytes={max_bytes} must be >= 0")
         self.cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
-        os.makedirs(self.cache_dir, exist_ok=True)
+        if not read_only:
+            os.makedirs(self.cache_dir, exist_ok=True)
+        self.max_bytes = max_bytes
+        self.read_only = read_only
+        self.bytes_gauge = bytes_gauge
         self._lock = threading.Lock()
         self.disabled = False
         self.loads = 0       # warm hits served from disk
         self.stores = 0
         self.misses = 0
+        self.evictions = 0
+        if bytes_gauge is not None:
+            bytes_gauge.set(self.total_bytes())
 
+    # ------------------------------------------------------------- layout
     def _path(self, key: str) -> str:
-        return os.path.join(self.cache_dir, f"{key}.jaxexe")
+        """Sharded canonical path: ``<store>/<key[:2]>/<key>.jaxexe``."""
+        return os.path.join(self.cache_dir, key[:2],
+                            f"{key}{ENTRY_SUFFIX}")
 
+    def _legacy_path(self, key: str) -> str:
+        """Round-13 flat layout, still honored on load."""
+        return os.path.join(self.cache_dir, f"{key}{ENTRY_SUFFIX}")
+
+    def _entries(self) -> List[Tuple[str, int, float]]:
+        """Every entry file as ``(path, size, atime)`` — flat and
+        sharded layouts alike; never raises (a racing eviction or an
+        unshared store mid-write just drops out of the listing)."""
+        out: List[Tuple[str, int, float]] = []
+        try:
+            roots = [self.cache_dir] + [
+                os.path.join(self.cache_dir, d)
+                for d in os.listdir(self.cache_dir)
+                if len(d) == 2
+                and os.path.isdir(os.path.join(self.cache_dir, d))]
+        except OSError:
+            return out
+        for root in roots:
+            try:
+                names = os.listdir(root)
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(ENTRY_SUFFIX):
+                    continue
+                path = os.path.join(root, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append((path, st.st_size, st.st_atime))
+        return out
+
+    def total_bytes(self) -> int:
+        """Bytes of executable entries on disk (manifest sidecars are
+        noise-level and not counted)."""
+        return sum(size for _, size, _ in self._entries())
+
+    # ----------------------------------------------------------------- load
     def load(self, key: str):
         if self.disabled:
             return None
         path = self._path(key)
+        if not os.path.exists(path):
+            legacy = self._legacy_path(key)
+            path = legacy if os.path.exists(legacy) else path
         try:
             with open(path, "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
@@ -133,12 +216,25 @@ class ExecutableDiskCache:
             with self._lock:
                 self.misses += 1
             return None
+        # Mark use explicitly: LRU eviction orders by atime, and noatime
+        # mounts would otherwise never see reads.  Best-effort (a
+        # read-only mount cannot utime — fine, its GC runs elsewhere).
+        try:
+            os.utime(path)
+        except OSError:
+            pass
         with self._lock:
             self.loads += 1
         return exe
 
-    def store(self, key: str, compiled) -> bool:
-        if self.disabled:
+    # ---------------------------------------------------------------- store
+    def store(self, key: str, compiled,
+              meta: Optional[Dict[str, Any]] = None) -> bool:
+        """Serialize ``compiled`` under ``key``; ``meta`` (optional)
+        lands in a ``<key>.json`` manifest sidecar so a human (or an
+        audit job) can read WHAT each content hash is without
+        deserializing it."""
+        if self.disabled or self.read_only:
             return False
         try:
             from jax.experimental import serialize_executable
@@ -154,6 +250,7 @@ class ExecutableDiskCache:
         path = self._path(key)
         tmp = f"{path}.tmp-{os.getpid()}"
         try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(tmp, "wb") as f:
                 f.write(blob)
                 f.flush()
@@ -167,15 +264,71 @@ class ExecutableDiskCache:
             except OSError:
                 pass
             return False
+        if meta is not None:
+            self._write_manifest(key, meta, len(blob))
         with self._lock:
             self.stores += 1
+        self.gc()
         return True
+
+    def _write_manifest(self, key: str, meta: Dict[str, Any],
+                        size: int) -> None:
+        mpath = os.path.join(os.path.dirname(self._path(key)),
+                             f"{key}{MANIFEST_SUFFIX}")
+        tmp = f"{mpath}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"key": key, "bytes": size,
+                           "backend": backend_fingerprint(), **meta},
+                          f, indent=1, sort_keys=True, default=str)
+            os.replace(tmp, mpath)
+        except OSError:   # the manifest is advisory — never fail a store
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------- gc
+    def gc(self) -> int:
+        """Evict least-recently-used entries until the store fits
+        ``max_bytes``; returns the number evicted.  Also refreshes the
+        bytes gauge.  No-op without a bound (the gauge still updates)."""
+        entries = self._entries()
+        total = sum(size for _, size, _ in entries)
+        evicted = 0
+        if (self.max_bytes is not None and not self.read_only
+                and total > self.max_bytes):
+            for path, size, _ in sorted(entries, key=lambda e: e[2]):
+                if total <= self.max_bytes:
+                    break
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                try:   # the manifest dies with its entry
+                    os.unlink(path[:-len(ENTRY_SUFFIX)]
+                              + MANIFEST_SUFFIX)
+                except OSError:
+                    pass
+                total -= size
+                evicted += 1
+            if evicted:
+                with self._lock:
+                    self.evictions += evicted
+                log.info("executable cache GC: evicted %d LRU entr%s "
+                         "(max_bytes=%d, now %d bytes)", evicted,
+                         "y" if evicted == 1 else "ies",
+                         self.max_bytes, total)
+        if self.bytes_gauge is not None:
+            self.bytes_gauge.set(total)
+        return evicted
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"loads": self.loads, "stores": self.stores,
-                    "misses": self.misses,
-                    "disabled": int(self.disabled)}
+                    "misses": self.misses, "evictions": self.evictions,
+                    "disabled": int(self.disabled),
+                    "read_only": int(self.read_only)}
 
 
 def enable_persistent_compilation_cache(cache_dir: str) -> bool:

@@ -176,6 +176,7 @@ class BrownoutController:
         self._sink = sink
         self._lock = threading.Lock()
         self._level = 0
+        self._floor = 0     # fleet-wide minimum (set_floor; round 16)
         self._pressure_since: Optional[float] = None
         self._calm_since: Optional[float] = None
         self._prev_admitted = 0
@@ -186,8 +187,41 @@ class BrownoutController:
     # ----------------------------------------------------------- degrade
     @property
     def level(self) -> int:
+        """The EFFECTIVE degradation level: the local pressure state
+        machine's rung, or the fleet-wide floor if that is higher (the
+        router pushes the floor so every replica steps down together;
+        local pressure can still degrade further on top)."""
         with self._lock:
-            return self._level
+            return max(self._level, self._floor)
+
+    @property
+    def floor(self) -> int:
+        with self._lock:
+            return self._floor
+
+    def set_floor(self, level: int) -> int:
+        """Set the fleet-wide minimum level (``POST /admin/brownout`` ->
+        engine.set_brownout_floor).  Clamped to the ladder; returns the
+        effective level.  The local controller keeps polling its own
+        signals — the floor only prevents it from RESTORING below the
+        fleet's verdict."""
+        level = max(0, min(int(level), self.max_level))
+        with self._lock:
+            old_eff = max(self._level, self._floor)
+            self._floor = level
+            eff = max(self._level, self._floor)
+            if self._gauge is not None:
+                self._gauge.set(eff)
+        if eff != old_eff:
+            log.warning("brownout floor set to %d (effective level "
+                        "%d -> %d, fleet-pushed)", level, old_eff, eff)
+            if self._sink is not None:
+                self._sink.fire("brownout_engaged" if eff > old_eff
+                                else "brownout_restored",
+                                level=eff, previous_level=old_eff,
+                                reason="fleet_floor", floor=level,
+                                ladder=list(self.ladder))
+        return eff
 
     @property
     def max_level(self) -> int:
@@ -208,7 +242,7 @@ class BrownoutController:
         """Caller holds the lock."""
         old, self._level = self._level, new
         if self._gauge is not None:
-            self._gauge.set(new)
+            self._gauge.set(max(new, self._floor))
         log.warning("brownout level %d -> %d (%s)", old, new, reason)
         if self._sink is not None:
             self._sink.fire("brownout_engaged" if new > old

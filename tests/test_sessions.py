@@ -617,3 +617,82 @@ def test_http_sessions_disabled_400(tiny_model):
     finally:
         server.shutdown()
         svc.close()
+
+
+# --------------------------------------------- crashed session dispatches
+# Round-16 regression (r13 requeue x r14 submit_session cross): a chaos-
+# crashed dispatch carrying a SESSION frame must release the per-session
+# ordering lock through its future and invalidate warm state, so the
+# requeued frame cold-starts instead of chaining off a flow the crashed
+# dispatch never produced.
+
+def test_crashed_warm_frame_cold_retries_and_stream_survives(tiny_model):
+    """A warm frame whose dispatch crashes is demoted to a COLD start
+    for its retry (a crash caused by the warm init would otherwise burn
+    every attempt deterministically), the session's stored state is
+    dropped, and the stream keeps flowing — the ordering lock is
+    released by the retry's success, never leaked."""
+    from raft_stereo_tpu.serving import (ChaosConfig, ChaosInjector,
+                                         ServeConfig, StereoService)
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS, sessions=True,
+                                   max_dispatch_attempts=3,
+                                   retry_backoff_ms=1.0)) as svc:
+        f0 = svc.infer_session("s", left, right, timeout=300)
+        assert not f0.warm                       # cold seed, clean
+        # Arm chaos only now (the dispatch path re-reads the attribute):
+        # the NEXT dispatch — frame 1, warm — crashes exactly once.
+        svc.chaos = ChaosInjector(
+            ChaosConfig(seed=1, crash_rate=1.0, max_faults=1),
+            observe=svc.metrics.observe_injected_fault)
+        f1 = svc.infer_session("s", left, right, timeout=300)
+        assert f1.attempts == 2, "the crash must have been retried"
+        assert not f1.warm, \
+            "the requeued frame must COLD-start: its warm init was " \
+            "voided by the crash"
+        assert svc.metrics.retries.value == 1
+        # lock released + state re-seeded by the cold retry: the next
+        # frame warm-starts off the RETRY's output.
+        f2 = svc.infer_session("s", left, right, timeout=300)
+        assert f2.warm and f2.attempts == 1
+        assert svc.sessions.get("s").cold_frames == 2
+
+
+def test_poisoned_session_frame_releases_lock_and_next_frame_cold(
+        tiny_model):
+    """A session frame poisoned (crashed on every attempt) must release
+    the ordering lock via its typed failure AND leave the session in a
+    cold-start state: the next frame must not warm-chain across the gap
+    off a flow the poisoned dispatch never produced."""
+    from raft_stereo_tpu.serving import (ChaosConfig, ChaosInjector,
+                                         RequestPoisoned, ServeConfig,
+                                         StereoService)
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS, sessions=True,
+                                   max_dispatch_attempts=1)) as svc:
+        f0 = svc.infer_session("s", left, right, timeout=300)
+        assert not f0.warm
+        assert svc.sessions.get("s").flow_low is not None
+        svc.chaos = ChaosInjector(
+            ChaosConfig(seed=2, crash_rate=1.0, max_faults=1),
+            observe=svc.metrics.observe_injected_fault)
+        with pytest.raises(RequestPoisoned):
+            svc.infer_session("s", left, right, timeout=300)
+        assert svc.metrics.poisoned.value == 1
+        # the warm state died with the crashed dispatch
+        assert svc.sessions.get("s").flow_low is None
+        # lock released by the typed failure: the stream continues, COLD
+        f2 = svc.infer_session("s", left, right, timeout=300)
+        assert not f2.warm, \
+            "the frame after a poisoned one must cold-start (no " \
+            "chaining across the gap)"
+        f3 = svc.infer_session("s", left, right, timeout=300)
+        assert f3.warm                           # chain re-established

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Compile farm: build a serving config's full executable ladder ONCE
+into the shared artifact store, so every fleet replica boots warm.
+
+The serving executable surface is a product — warmup shapes x batch
+sizes x distinct tier programs x executable families (base / session
+state / warm) — and round 13 measured ~23.6 s of XLA compile per rung at
+realtime shapes.  Paying that product on every replica boot is exactly
+the cold-start storm the fleet design removes: this job AOT-compiles the
+whole ladder through the SAME engine prewarm path a replica uses (so the
+content-addressed keys match by construction — same code path, same
+coordinates, same backend fingerprint) and serializes every executable
+into ``--out``.  Replicas then point ``--executable_cache_dir`` at the
+store (optionally ``--executable_cache_read_only``) and their prewarm is
+an artifact FETCH: ``/readyz`` opens with ``serve_compiles_cold_total
+== 0``, which scripts/fleet_smoke.py asserts across a fresh 3-replica
+fleet.
+
+    JAX_PLATFORMS=cpu python tools/compile_farm.py \\
+        --restore_ckpt ckpt --out /shared/raft-artifacts \\
+        --shape 375x1242 --tiers interactive,quality --batch_sizes 1,2 \\
+        --sessions --manifest FARM_MANIFEST.json
+
+The store layout is serving/persist.py's: ``<key[:2]>/<key>.jaxexe``
+entries (SHA-256 content keys over config + shape + batch + tier +
+family + backend fingerprint) with ``.json`` manifest sidecars.  Keys
+are content hashes, so re-running the farm is idempotent and concurrent
+farms (one per backend kind) can share one store.  The farm must run on
+the SAME jax version / backend / device kind as the replicas — a
+mismatched fingerprint just misses cleanly and the replica recompiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+log = logging.getLogger("compile_farm")
+
+
+def _parse_hw(text: str):
+    try:
+        h, w = text.lower().split("x")
+        return (int(h), int(w))
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"{text!r}: expected HxW, e.g. 375x1242") from e
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_stereo_tpu.cli import common
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--restore_ckpt", required=True,
+                   help=".pth or orbax checkpoint directory (the exact "
+                        "weights the replicas will serve — the config "
+                        "is part of every content key)")
+    p.add_argument("--out", required=True,
+                   help="artifact-store directory to populate (the "
+                        "replicas' --executable_cache_dir)")
+    p.add_argument("--shape", type=_parse_hw, action="append",
+                   required=True,
+                   help="raw HxW to build the bucket ladder for "
+                        "(repeatable) — must match the replicas' "
+                        "--warmup_shape set")
+    p.add_argument("--batch_sizes", default="1,2,4,8")
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--tiers", default="interactive,balanced,quality",
+                   help="tier list, exactly as the replicas serve it")
+    p.add_argument("--default_tier", default=None)
+    p.add_argument("--valid_iters", type=int, default=32)
+    p.add_argument("--shape_bucket", type=int, default=None)
+    p.add_argument("--fetch_dtype", default=None,
+                   choices=["fp16", "bf16"])
+    p.add_argument("--sessions", action="store_true",
+                   help="also build the session state/warm families "
+                        "(replicas running --sessions need them)")
+    p.add_argument("--session_ctx_cache", action="store_true")
+    p.add_argument("--quant_scales", default=None)
+    p.add_argument("--max_bytes", type=int, default=None,
+                   help="GC bound applied to the store after the build")
+    p.add_argument("--manifest", default=None,
+                   help="write a JSON build manifest here (ladder "
+                        "coordinates, artifact count, bytes, wall time)")
+    common.add_arch_overrides(p)
+    return p
+
+
+def run(args) -> int:
+    from raft_stereo_tpu.cli import common
+    from raft_stereo_tpu.serving import (ServeConfig, StereoService,
+                                         enable_persistent_compilation_cache)
+    from raft_stereo_tpu.serving.persist import backend_fingerprint
+
+    enable_persistent_compilation_cache(args.out)
+    cfg, variables = common.load_any_checkpoint(
+        args.restore_ckpt, **common.arch_overrides(args))
+    tiers = tuple(t.strip() for t in (args.tiers or "").split(",")
+                  if t.strip())
+    serve_cfg = ServeConfig(
+        max_batch=args.max_batch,
+        batch_sizes=tuple(int(s) for s in args.batch_sizes.split(",")),
+        iters=args.valid_iters,
+        tiers=tiers, default_tier=args.default_tier,
+        shape_bucket=args.shape_bucket,
+        fetch_dtype=args.fetch_dtype,
+        sessions=args.sessions,
+        session_ctx_cache=args.session_ctx_cache,
+        quant_scales_path=args.quant_scales,
+        executable_cache_dir=args.out,
+        executable_cache_max_bytes=args.max_bytes,
+        warmup_shapes=tuple(args.shape),
+        prewarm_on_init=False)
+    t0 = time.perf_counter()
+    svc = StereoService(cfg, variables, serve_cfg)
+    try:
+        for hw in args.shape:
+            svc.prewarm(hw)
+        if not svc.ready:
+            log.error("farm prewarm did not open the readiness gate: %s",
+                      svc.warm_status())
+            return 1
+        built = svc.metrics.compiles_cold.value
+        reused = svc.metrics.compiles_warm.value
+        cache = svc.disk_cache
+        wall_s = time.perf_counter() - t0
+        manifest = {
+            "store": os.path.abspath(args.out),
+            "backend": backend_fingerprint(),
+            "shapes": [list(s) for s in args.shape],
+            "batch_sizes": sorted(svc.queue.sizes),
+            "tiers": list(tiers),
+            "families": [f or "base" for f in svc._families()],
+            "sessions": bool(args.sessions),
+            "iters": args.valid_iters,
+            "artifacts_built": built,
+            "artifacts_reused": reused,
+            "store_stats": cache.stats() if cache is not None else None,
+            "store_bytes": (cache.total_bytes()
+                            if cache is not None else None),
+            "wall_s": round(wall_s, 3),
+        }
+    finally:
+        svc.close()
+    log.info("compile farm done: %d built + %d reused in %.1fs -> %s "
+             "(%s bytes)", built, reused, wall_s, manifest["store"],
+             manifest["store_bytes"])
+    print(json.dumps(manifest, indent=1))
+    if args.manifest:
+        with open(args.manifest, "w") as f:
+            json.dump(manifest, f, indent=1)
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)-8s [%(name)s] %(message)s")
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
