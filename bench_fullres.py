@@ -41,6 +41,13 @@ def main():
     ap.add_argument("--banded", action="store_true",
                     help="banded encoder (models/banded.py): several-fold "
                          "lower peak HBM, ~20%% slower at full res")
+    ap.add_argument("--xl_mesh", default=None,
+                    help="also measure the mesh-SHARDED forward (e.g. "
+                         "'rows=4'): peak HBM becomes per-device and the "
+                         "ROWSGRU memory wall drops ~1/N — the raw-"
+                         "forward twin of the serving xl tier "
+                         "(bench_serve.py --xl measures the engine "
+                         "path).  Needs rows*corr local devices")
     args = ap.parse_args()
 
     from raft_stereo_tpu.config import RaftStereoConfig
@@ -57,12 +64,50 @@ def main():
         {"metric": "fullres_inference_run", "banded": args.banded,
          "iters": ITERS, "sizes": [f"{h}x{w}" for h, w in SIZES]})))
 
+    import contextlib
+
+    # Mesh-sharded variant (--xl_mesh): trace the same chained forward
+    # with rows/corr sharding active — every compile below then reports
+    # PER-DEVICE memory_analysis, directly comparable to the solo rows.
+    mesh_ctx = contextlib.nullcontext
+    mesh_kw = {}
+    if args.xl_mesh:
+        from raft_stereo_tpu.parallel.mesh import (ROWS_AXIS, make_mesh,
+                                                   parse_mesh_spec)
+        from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
+        spec = parse_mesh_spec(args.xl_mesh)
+        mesh = make_mesh(n_data=1, n_corr=spec["corr"],
+                         n_rows=spec["rows"],
+                         devices=jax.devices()[:spec["rows"]
+                                               * spec["corr"]])
+        mesh_kw = {"rows_shards": spec["rows"],
+                   "corr_w2_shards": spec["corr"],
+                   "rows_gru": spec["rows"] > 1 and spec["corr"] == 1}
+        if spec["rows"] > 1:
+            mesh_ctx = lambda: rows_sharding(mesh, ROWS_AXIS)  # noqa: E731
+        if spec["corr"] > 1:
+            from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
+            prev_ctx = mesh_ctx
+
+            def mesh_ctx():
+                stack = contextlib.ExitStack()
+                stack.enter_context(prev_ctx())
+                stack.enter_context(corr_sharding(mesh))
+                return stack
+
     rng = np.random.default_rng(0)
     results = []
     variables = None
     for backend in BACKENDS:
-        cfg = RaftStereoConfig(corr_backend=backend,
-                               banded_encoder=args.banded)
+        try:
+            cfg = RaftStereoConfig(corr_backend=backend,
+                                   banded_encoder=args.banded, **mesh_kw)
+        except ValueError as e:   # e.g. corr sharding x volume-free 'alt'
+            print(json.dumps({"metric": "fullres_inference",
+                              "backend": backend,
+                              "xl_mesh": args.xl_mesh,
+                              "skipped": str(e)[:160]}))
+            continue
         model = RAFTStereo(cfg)
         if variables is None:
             img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
@@ -84,17 +129,35 @@ def main():
             rec = {"metric": "fullres_inference", "backend": backend,
                    "size": f"{h}x{w}", "iters": ITERS,
                    "banded_encoder": args.banded}
+            if args.xl_mesh:
+                rec["xl_mesh"] = args.xl_mesh
+                rec["hbm_is_per_device"] = True
             try:
-                compiled = chain.lower(variables, img1, img2, 1).compile()
+                with mesh_ctx():
+                    compiled = chain.lower(variables, img1, img2,
+                                           1).compile()
                 ma = compiled.memory_analysis()
-                rec["peak_hbm_gib"] = round(
-                    ma.peak_memory_in_bytes / 2 ** 30, 3)
+                # peak_memory_in_bytes is TPU-backend; CPU builds of
+                # some jax versions expose only the size fields — fall
+                # back to their sum so the per-device comparison stays
+                # measurable everywhere.
+                peak = getattr(ma, "peak_memory_in_bytes", None)
+                if peak is None:
+                    peak = (ma.temp_size_in_bytes
+                            + ma.argument_size_in_bytes
+                            + ma.output_size_in_bytes)
+                    rec["hbm_is_live_sum"] = True
+                rec["peak_hbm_gib"] = round(peak / 2 ** 30, 3)
                 rec["temp_gib"] = round(ma.temp_size_in_bytes / 2 ** 30, 3)
 
                 def make_chain(k):
                     if k == 1:  # reuse the executable compiled above
                         return lambda: float(compiled(variables, img1, img2))
-                    return lambda: float(chain(variables, img1, img2, k))
+
+                    def run_k():
+                        with mesh_ctx():   # k>1 traces a fresh program
+                            return float(chain(variables, img1, img2, k))
+                    return run_k
 
                 per_image = chained_seconds_per_call(
                     make_chain, k_lo=K_LO, k_hi=K_HI, repeats=REPEATS)

@@ -42,6 +42,7 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 
 OUT = "BENCH_SERVE_r15.json"
 BASELINE = "BENCH_SERVE_r06.json"
+XL_OUT = "BENCH_XL_r17.json"
 
 
 def build_model(on_cpu: bool):
@@ -320,6 +321,129 @@ def compare_to_baseline(best_hz: float, sweep: list) -> dict:
     return cmp
 
 
+def xl_sweep_main():
+    """``python bench_serve.py --xl`` — the XL serving-tier sweep
+    (round 17): ONE big bucket measured three ways through the SAME
+    engine — solo single-device dispatch, mesh-sharded xl dispatch at
+    each rows width, and the halo-tiled fallback — recording per-device
+    HBM from the compile registry's memory_analysis (the
+    ROWSGRU_MEMORY_r05 scaling claim, now measured through the serving
+    path), ms/image, xl-vs-solo parity, and the tiles' measured seam
+    EPE.  Writes BENCH_XL_r17.json.
+
+    On CPU the backend is forced to 8 virtual devices (the MULTICHIP /
+    tier-1 mesh harness) and the model shrinks; on an accelerator it
+    runs the full architecture at Middlebury-F-class shapes."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from _hermetic import force_cpu
+        force_cpu(8)
+    import jax
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.serving import ServeConfig, ServingEngine
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
+    import jax.numpy as jnp
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg = RaftStereoConfig(hidden_dims=(48, 48, 48), fnet_dim=96,
+                               corr_levels=2, corr_radius=3,
+                               corr_backend="reg")
+        hw, iters, meshes = (512, 640), 4, ("rows=2", "rows=4")
+    else:
+        cfg = RaftStereoConfig()            # the accuracy architecture
+        hw, iters, meshes = (1984, 2880), 32, ("rows=2", "rows=4")
+    model = RAFTStereo(cfg)
+    img_s = jnp.zeros((1, 64, 96, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img_s, img_s, iters=1,
+                                             test_mode=True)
+                        )(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    left = rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+    right = np.roll(left, -5, axis=1)
+    rows_out = []
+
+    def _measure(engine, label, n_timed=3, **extra):
+        res = engine.infer(left, right, timeout=3600)   # warm/compile
+        times = []
+        for _ in range(n_timed):
+            t0 = time.perf_counter()
+            res = engine.infer(left, right, timeout=3600)
+            times.append(time.perf_counter() - t0)
+        rec = engine.compiled_cost(
+            engine.bucket_for(left.shape), 1,
+            family="xl" if res.tier == "xl" else None)
+        row = {"row": label, "bucket": f"{hw[0]}x{hw[1]}",
+               "iters": iters, "ms_per_image": round(
+                   float(np.median(times)) * 1e3, 1),
+               "tier": res.tier,
+               "per_device_hbm_mib": (
+                   round(rec.hbm_bytes / 2 ** 20, 1)
+                   if rec is not None and rec.hbm_bytes else None),
+               **extra}
+        rows_out.append(row)
+        print(json.dumps(row), flush=True)
+        return res, row
+
+    # Solo single-device row — the comparison line every xl/tiled row
+    # is judged against.
+    with ServingEngine(cfg, variables, ServeConfig(
+            iters=iters, cost_telemetry=True)) as eng:
+        solo_res, solo_row = _measure(eng, "solo")
+
+    for mesh in meshes:
+        with ServingEngine(cfg, variables, ServeConfig(
+                iters=iters, cost_telemetry=True, xl_mesh=mesh,
+                xl_threshold_pixels=1000)) as eng:
+            if not eng.xl_enabled:
+                print(json.dumps({"row": f"xl {mesh}",
+                                  "skipped": "not enough devices"}),
+                      flush=True)
+                continue
+            res, row = _measure(eng, f"xl {mesh}")
+            row["max_abs_vs_solo"] = round(float(
+                np.abs(res.flow - solo_res.flow).max()), 6)
+            row["hbm_vs_solo"] = (
+                round(row["per_device_hbm_mib"]
+                      / solo_row["per_device_hbm_mib"], 3)
+                if row["per_device_hbm_mib"]
+                and solo_row["per_device_hbm_mib"] else None)
+            if (row["per_device_hbm_mib"] and solo_row["per_device_hbm_mib"]
+                    and row["per_device_hbm_mib"]
+                    >= solo_row["per_device_hbm_mib"]):
+                print(f"WARNING: xl {mesh} per-device HBM "
+                      f"{row['per_device_hbm_mib']} MiB is not below the "
+                      f"solo figure {solo_row['per_device_hbm_mib']} MiB",
+                      flush=True)
+
+    # Halo-tiled fallback row: the same pair through ordinary bucket
+    # dispatches (beyond-mesh path), seam error measured.
+    tile_rows = 256 if on_cpu else 512
+    with ServingEngine(cfg, variables, ServeConfig(
+            iters=iters, cost_telemetry=True,
+            tile_threshold_pixels=1000, tile_rows=tile_rows,
+            tile_halo=64)) as eng:
+        res, row = _measure(eng, "tiled")
+        row["tiles"] = res.tiles
+        row["seam_epe_px"] = (round(res.seam_epe, 4)
+                              if res.seam_epe is not None else None)
+        row["max_abs_vs_solo"] = round(float(
+            np.abs(res.flow - solo_res.flow).max()), 6)
+
+    rec = bench_record({
+        "metric": "serve_xl_sweep",
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "bucket": f"{hw[0]}x{hw[1]}", "iters": iters,
+        "rows": rows_out,
+    })
+    print(json.dumps(rec))
+    write_record(os.path.join(_REPO, XL_OUT), rec, indent=1)
+
+
 def main():
     import jax
 
@@ -385,4 +509,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--xl" in sys.argv:
+        xl_sweep_main()
+    else:
+        main()

@@ -90,6 +90,15 @@ def build_service(args):
         session_ctx_cache=args.session_ctx_cache,
         ctx_cache_threshold=args.ctx_cache_threshold,
         quant_scales_path=args.quant_scales,
+        xl_mesh=args.xl_mesh,
+        xl_workers=args.xl_workers,
+        xl_threshold_pixels=args.xl_threshold_pixels,
+        xl_max_pixels=args.xl_max_pixels,
+        xl_batch_sizes=tuple(int(s)
+                             for s in args.xl_batch_sizes.split(",")),
+        tile_threshold_pixels=args.tile_threshold_pixels,
+        tile_rows=args.tile_rows,
+        tile_halo=args.tile_halo,
         warmup_shapes=tuple(args.warmup_shape or ()),
         prewarm_on_init=False)
     return StereoService(cfg, variables, serve_cfg)
@@ -181,7 +190,8 @@ def run_serve(args) -> int:
             signal.signal(sig, _graceful)
 
     log.info("serving on %s (batch sizes %s, queue<=%d, %d device "
-             "worker(s), %s buckets, tiers %s, sessions %s)", server.url,
+             "worker(s), %s buckets, tiers %s, sessions %s, xl %s)",
+             server.url,
              service.queue.sizes, service.serve_cfg.max_queue,
              len(service.devices),
              "adaptive" if service.policy.adaptive else "static",
@@ -189,7 +199,10 @@ def run_serve(args) -> int:
               if service.tiers else "off"),
              (f"on (ttl {service.serve_cfg.session_ttl_s:.0f}s, "
               f"capacity {service.serve_cfg.session_capacity})"
-              if service.sessions is not None else "off"))
+              if service.sessions is not None else "off"),
+             (f"{service.serve_cfg.xl_mesh} "
+              f"(>{service.serve_cfg.xl_threshold_pixels}px)"
+              if service.xl_enabled else "off"))
     try:
         # serve_forever already runs on the server thread (started above
         # so readiness answered during prewarm); park the main thread on
@@ -408,6 +421,52 @@ def build_parser() -> argparse.ArgumentParser:
                         "or below which a warm frame may reuse the "
                         "cached context — the static-scene gate, far "
                         "below the scene-cut threshold by design")
+    # XL tier + tiling fallback (docs/architecture.md §Serving, "XL tier").
+    p.add_argument("--xl_mesh", default=None,
+                   help="serve an XL tier whose bucket executables are "
+                        "SHARDED over a device mesh, e.g. 'rows=4' "
+                        "(image-row context parallelism through the "
+                        "whole forward) or 'rows=2,corr=2' (rows-sharded "
+                        "encoders x disparity-sharded correlation "
+                        "volume).  One xl worker owns rows*corr devices "
+                        "(allocated after the --data_parallel solo "
+                        "workers); requests whose padded bucket exceeds "
+                        "--xl_threshold_pixels (or that pass ?tier=xl) "
+                        "run ONE mesh-sharded dispatch instead of one "
+                        "device.  A replica with too few devices for "
+                        "the mesh logs a typed skip and serves without "
+                        "the tier")
+    p.add_argument("--xl_workers", type=int, default=1,
+                   help="independent xl device groups (each of "
+                        "rows*corr devices)")
+    p.add_argument("--xl_threshold_pixels", type=int, default=2_000_000,
+                   help="padded-bucket pixel count above which requests "
+                        "route to the xl family automatically")
+    p.add_argument("--xl_max_pixels", type=int, default=None,
+                   help="the mesh's own ceiling: buckets past this fall "
+                        "through to halo-overlap tiling (size it from "
+                        "the mesh's measured per-device HBM); unset = "
+                        "the mesh takes everything above the threshold")
+    p.add_argument("--xl_batch_sizes", default="1",
+                   help="comma list of batch sizes compiled per xl "
+                        "bucket (default 1: megapixel pairs are "
+                        "latency-bound and the mesh already uses the "
+                        "devices)")
+    p.add_argument("--tile_threshold_pixels", type=int, default=None,
+                   help="padded-bucket pixel count above which requests "
+                        "that did not take the xl route are answered by "
+                        "halo-overlap row tiling through the ordinary "
+                        "batcher (tiles of one image batch together; "
+                        "responses carry X-Tiles and the measured "
+                        "X-Seam-EPE).  Unset: never tile")
+    p.add_argument("--tile_rows", type=int, default=512,
+                   help="owned rows per tile (each tile adds "
+                        "2*--tile_halo context rows)")
+    p.add_argument("--tile_halo", type=int, default=64,
+                   help="overlap rows on each side of a tile — vertical "
+                        "context for the encoders/GRU; the residual "
+                        "tile disagreement is measured per request as "
+                        "seam EPE (serve_tile_seam_epe)")
     p.add_argument("--quant_scales", default=None,
                    help="checkpoint-adjacent int8 calibration scale file "
                         "(quant/calibrate.py): int8 tiers (e.g. "

@@ -184,6 +184,115 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     return jax.jit(fwd, donate_argnums=(1, 2) if donate_images else ())
 
 
+class MeshForward:
+    """A mesh-sharded inference program with the ``make_forward`` calling
+    convention (``fn(variables, images1, images2) -> flow_up``), plus the
+    sharding-context plumbing a GSPMD trace needs.
+
+    The model's sharded executors (``parallel/rows_sharded.py`` trunk,
+    ``parallel/rows_gru.py`` loop, ``parallel/corr_sharded.py`` volume)
+    discover their mesh through context managers that must be ACTIVE
+    whenever the function traces — and jit traces lazily, at the first
+    call for each shape and inside ``.lower()`` on the AOT path.  This
+    wrapper re-enters the contexts around both entry points, so the
+    serving engine can treat a sharded program exactly like a solo one
+    (dispatch it, AOT-lower it for the persistent executable cache,
+    instrument it through the CompileRegistry)."""
+
+    def __init__(self, jitted, mesh, rows: int, corr: int):
+        self._jitted = jitted
+        self.mesh = mesh
+        self._rows = rows
+        self._corr = corr
+
+    def _contexts(self):
+        import contextlib
+
+        from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
+        from raft_stereo_tpu.parallel.mesh import ROWS_AXIS
+        from raft_stereo_tpu.parallel.rows_sharded import rows_sharding
+
+        stack = contextlib.ExitStack()
+        if self._rows > 1:
+            stack.enter_context(rows_sharding(self.mesh, ROWS_AXIS))
+        if self._corr > 1:
+            stack.enter_context(corr_sharding(self.mesh))
+        return stack
+
+    def __call__(self, *args):
+        with self._contexts():
+            return self._jitted(*args)
+
+    def lower(self, *args, **kwargs):
+        with self._contexts():
+            return self._jitted.lower(*args, **kwargs)
+
+
+def make_forward_mesh(model: RAFTStereo, iters: int, mesh,
+                      fetch_dtype=None, donate_images: bool = True):
+    """Mesh-sharded variant of ``make_forward``: ONE jitted program whose
+    forward runs sharded over ``mesh`` per the model config's
+    ``rows_shards`` / ``corr_w2_shards`` (+ ``rows_gru`` for full-loop
+    context parallelism), with the image buffers and parameters
+    replicated in and the full-resolution disparity GATHERED out — the
+    program an "xl" serving bucket dispatches when one full-resolution
+    pair cannot fit (or meet latency) on one device
+    (ROWSGRU_MEMORY_r05.json: 141 GiB at rows=1 vs 13.8 GiB/device on a
+    16-way rows mesh).
+
+    Same calling convention and numerics contract as the base program:
+    ``fn(variables, images1, images2) -> (N, Hp, Wp) flow`` with the
+    sharded output equal to the solo program's up to float reassociation
+    (the MULTICHIP_r01–r05 parity line; tests/test_xl.py pins 5e-4).
+    With a trivial mesh (every axis 1) this IS ``make_forward`` — the
+    identical jaxpr, bitwise, so a rows=1 xl tier degrades to the solo
+    program instead of a subtly different one.
+
+    Restrictions (validated here so misconfigurations fail at build, not
+    mid-dispatch): early exit is unsupported (the row-sharded loop
+    executor runs a fixed-depth program — config.py already rejects the
+    combination; the corr-only mesh inherits the same contract so every
+    xl program has one output arity), and so are the streaming
+    warm/ctx families (sessions stay single-device)."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = model.config
+    rows, corr = cfg.rows_shards, cfg.corr_w2_shards
+    if early_exit_enabled(cfg):
+        raise ValueError(
+            "make_forward_mesh: early exit (exit_threshold_px > 0) is "
+            "unsupported on mesh-sharded programs — xl tiers run the "
+            "fixed-depth program")
+    if rows <= 1 and corr <= 1:
+        # Trivial mesh: the solo program, bitwise (tests/test_xl.py).
+        return make_forward(model, iters, fetch_dtype,
+                            donate_images=donate_images)
+
+    def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
+        img1 = images1.astype(jnp.float32)
+        img2 = images2.astype(jnp.float32)
+        out = model.apply(variables, img1, img2, iters=iters,
+                          test_mode=True)
+        flow_up = out[1]
+        if fetch_dtype is not None:
+            flow_up = flow_up.astype(fetch_dtype)
+        return flow_up
+
+    # Replicated in, gathered out: the host uploads each image once per
+    # device (megabytes — small next to the sharded activations), the
+    # shard_map executors inside re-slice to their own row/bin spans, and
+    # the caller fetches one assembled full-res disparity with no
+    # host-side reassembly.
+    repl = NamedSharding(mesh, P())
+    jitted = jax.jit(fwd,
+                     donate_argnums=(1, 2) if donate_images else (),
+                     in_shardings=(repl, repl, repl),
+                     out_shardings=repl)
+    return MeshForward(jitted, mesh, rows, corr)
+
+
 @dataclasses.dataclass
 class StreamFrame:
     """One frame of a warm-started sequence (``InferenceRunner.run_stream``).

@@ -1,0 +1,52 @@
+"""jax API compatibility for the sharded executors.
+
+The ``parallel/`` executors were written against the top-level
+``jax.shard_map`` API (``axis_names=`` manual axes, ``check_vma=``) and
+the varying-manual ``jax.lax.pcast``.  Older jax (the 0.4.x line this
+container ships) has neither: shard_map lives at
+``jax.experimental.shard_map.shard_map`` with the complementary ``auto=``
+(automatic axes) + ``check_rep=`` spelling, and ``pcast`` does not exist
+— its job (marking a constant scan carry as device-varying so the
+replication checker accepts a varying step output) is only needed by the
+new checker in the first place.
+
+This module is the one translation point, so every executor
+(rows_sharded / rows_gru / corr_sharded) runs on both API generations
+and none of them hand-rolls version sniffing.  On new jax the calls pass
+straight through; on old jax:
+
+* ``axis_names`` (manual) becomes ``auto = mesh.axis_names - axis_names``;
+* ``check_rep`` is pinned False — partial-auto shard_map predates a
+  working replication checker there, and the executors' correctness is
+  pinned numerically by tests/test_rows_*.py, not by the checker;
+* ``pcast_varying`` is the identity.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, axis_names, in_specs, out_specs,
+              check_vma: bool = True):
+    """``jax.shard_map`` with the new keyword surface, on either API
+    generation.  ``axis_names`` is the set of MANUAL axes (the new
+    spelling); all other mesh axes stay automatic."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, axis_names=set(axis_names),
+                             in_specs=in_specs, out_specs=out_specs,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False, auto=auto)
+
+
+def pcast_varying(x, axis):
+    """``jax.lax.pcast(x, (axis,), to="varying")`` where it exists; the
+    identity elsewhere (no varying-manual type system = nothing to
+    cast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return x

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -114,6 +114,54 @@ def any_process(flag: bool) -> bool:
 
     flags = multihost_utils.process_allgather(np.asarray(flag, np.int32))
     return bool(np.max(flags))
+
+
+def local_devices_stable() -> List[jax.Device]:
+    """This process's devices in a STABLE, process-independent order.
+
+    ``jax.local_devices()`` order is backend-defined; everything that
+    assigns work to devices by index — the serving engine's worker pool,
+    xl mesh groups, the multi-host loader slicing contract in
+    ``parallel/mesh.py`` — must agree on ONE ordering or two components
+    on the same host can claim overlapping devices.  Sorting by device id
+    makes the order a pure function of the topology."""
+    return sorted(jax.local_devices(), key=lambda d: d.id)
+
+
+def device_groups(group_size: int, n_groups: Optional[int] = None,
+                  devices: Optional[Sequence[jax.Device]] = None,
+                  skip: int = 0) -> List[Tuple[jax.Device, ...]]:
+    """Partition local devices into DISJOINT ordered groups of
+    ``group_size`` — the one helper the serving engine and the parallel
+    runtime share for device discovery (an engine worker owns one group;
+    an xl mesh group owns ``rows*corr`` devices).
+
+    Args:
+      group_size: devices per group (a solo worker is a 1-group; an xl
+        ``rows=2,corr=2`` mesh is a 4-group).
+      n_groups: how many groups to return; None = as many as fit.
+      devices: explicit device list (default ``local_devices_stable()``).
+      skip: leading devices to leave unassigned (e.g. the engine's solo
+        workers occupy the head of the list; xl groups start after them).
+
+    Returns the groups, each a tuple in stable order.  Returns an EMPTY
+    list — never raises — when the devices cannot supply ``n_groups``
+    full groups: the caller decides whether that is fatal (a declared
+    data_parallel) or a typed skip (a replica without enough devices for
+    the fleet's xl mesh, tools/compile_farm.py)."""
+    if group_size < 1:
+        raise ValueError(f"group_size={group_size} must be >= 1")
+    if skip < 0:
+        raise ValueError(f"skip={skip} must be >= 0")
+    if devices is None:
+        devices = local_devices_stable()
+    pool = list(devices)[skip:]
+    n_avail = len(pool) // group_size
+    want = n_avail if n_groups is None else int(n_groups)
+    if want < 0 or want > n_avail:
+        return []
+    return [tuple(pool[i * group_size:(i + 1) * group_size])
+            for i in range(want)]
 
 
 def loader_shard_kwargs() -> Dict[str, int]:

@@ -26,7 +26,7 @@ of SPMD.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -38,6 +38,56 @@ CORR_AXIS = "corr"
 # full-resolution segment (parallel/rows_sharded.py) — the stereo analog of
 # sequence parallelism, composing with data/corr on one mesh.
 ROWS_AXIS = "rows"
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """``"rows=4"`` / ``"rows=2,corr=2"`` → ``{"rows": 4, "corr": 2}``.
+
+    The serving-facing mesh declaration (``ServeConfig.xl_mesh`` /
+    ``raft-serve --xl_mesh``): only the two inference-sharding axes are
+    accepted — ``rows`` (image-row context parallelism,
+    parallel/rows_sharded.py + rows_gru.py) and ``corr`` (disparity-search
+    W2 sharding, parallel/corr_sharded.py).  Unnamed axes default to 1.
+    Raises ``ValueError`` on unknown axes, non-integer or < 1 sizes, or a
+    blank spec."""
+    out = {"rows": 1, "corr": 1}
+    seen = set()
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    if not parts:
+        raise ValueError(f"mesh spec {spec!r} is empty: use e.g. 'rows=4' "
+                         f"or 'rows=2,corr=2'")
+    for part in parts:
+        k, sep, v = part.partition("=")
+        k = k.strip()
+        if k not in out or not sep:
+            raise ValueError(
+                f"mesh spec {spec!r}: expected comma-separated "
+                f"'rows=N'/'corr=N' entries, got {part!r}")
+        if k in seen:
+            raise ValueError(f"mesh spec {spec!r}: axis {k!r} named twice")
+        seen.add(k)
+        try:
+            out[k] = int(v.strip())
+        except ValueError as e:
+            raise ValueError(f"mesh spec {spec!r}: size {v!r} for axis "
+                             f"{k!r} is not an integer") from e
+        if out[k] < 1:
+            raise ValueError(f"mesh spec {spec!r}: axis {k!r} size "
+                             f"{out[k]} must be >= 1")
+    return out
+
+
+def mesh_spec_label(spec: Dict[str, int]) -> str:
+    """Compact stable tag of a parsed mesh spec for executable keys and
+    metric labels: ``{"rows": 4, "corr": 1}`` → ``"rows4"``,
+    ``{"rows": 2, "corr": 2}`` → ``"rows2corr2"`` — what the serving
+    engine appends to compile-cost and persist keys (``",mesh=rows4"``)."""
+    out = ""
+    for axis in ("rows", "corr"):
+        n = int(spec.get(axis, 1))
+        if n > 1:
+            out += f"{axis}{n}"
+    return out or "solo"
 
 
 def make_mesh(n_data: int = 0, n_corr: int = 1, n_rows: int = 1,

@@ -22,9 +22,12 @@ from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
                                            parse_chaos_spec)
 from raft_stereo_tpu.serving.engine import (FAMILY_BASE, FAMILY_STATE,
                                             FAMILY_STATE_CTX, FAMILY_WARM,
-                                            FAMILY_WARM_CTX, BucketPolicy,
+                                            FAMILY_WARM_CTX, FAMILY_XL,
+                                            BucketPolicy,
                                             ServeConfig, ServeResult,
                                             ServingEngine, StereoService)
+from raft_stereo_tpu.serving.tiles import (TileSpec, plan_tiles, seam_epe,
+                                           stitch)
 from raft_stereo_tpu.serving.metrics import (MetricsRegistry, ServingMetrics)
 from raft_stereo_tpu.serving.persist import (ExecutableDiskCache,
                                              enable_persistent_compilation_cache,
@@ -53,5 +56,6 @@ __all__ = ["BucketQueue", "DeadlineExceeded", "Overloaded", "Request",
            "BrownoutController", "CircuitBreaker", "circuit_state_name",
            "cost_ladder", "FAMILY_BASE", "FAMILY_STATE",
            "FAMILY_STATE_CTX", "FAMILY_WARM", "FAMILY_WARM_CTX",
+           "FAMILY_XL", "TileSpec", "plan_tiles", "seam_epe", "stitch",
            "SessionExpired", "SessionsDisabled", "SessionStore",
            "StereoSession", "frame_delta", "frame_thumbnail"]

@@ -210,7 +210,11 @@ class BucketQueue:
             self._depth += 1
             self.metrics.admitted.inc()
             self.metrics.queue_depth.set(self._depth)
-            self._cond.notify()
+            # notify_all, not notify: with worker CLASSES (solo vs xl
+            # device groups, serving/engine.py) a single wake could land
+            # on a worker whose ``want`` filter rejects this request's
+            # group while an eligible worker sleeps on.
+            self._cond.notify_all()
 
     def requeue(self, reqs: Sequence[Request]) -> int:
         """Re-admit requests whose dispatch crashed (supervised recovery,
@@ -259,14 +263,18 @@ class BucketQueue:
         return requeued
 
     # ----------------------------------------------------------------- pop
-    def _oldest_bucket(self) -> Optional[Tuple]:
+    def _oldest_bucket(self, want=None) -> Optional[Tuple]:
         key, oldest = None, None
         for k, reqs in self._buckets.items():
+            if want is not None and not want(k):
+                continue
             if reqs and (oldest is None or reqs[0].t_enqueue < oldest):
                 key, oldest = k, reqs[0].t_enqueue
         return key
 
-    def pop(self, timeout: Optional[float] = None) -> Optional[List[Request]]:
+    def pop(self, timeout: Optional[float] = None, want=None,
+            sizes: Optional[Sequence[int]] = None
+            ) -> Optional[List[Request]]:
         """Take the next dispatch batch, blocking until one is available.
 
         Returns the oldest bucket's head ``pick_batch_size(depth)``
@@ -275,12 +283,20 @@ class BucketQueue:
         (worker shutdown) or ``timeout`` elapsed.  The survivors are
         counted into ``metrics.inflight`` before the lock drops, so
         ``drain``'s depth==0 + inflight==0 check never misses a batch in
-        hand."""
+        hand.
+
+        ``want`` (group-key predicate) restricts which groups this
+        caller may take — how the engine keeps mesh-sharded xl work on
+        the xl device groups and everything else on the solo workers
+        without a second queue (one admission bound, one depth gauge,
+        one drain).  ``sizes`` overrides the batch-size ladder for this
+        pop (xl buckets compile their own, typically shorter, ladder)."""
         deadline = None if timeout is None else self._clock() + timeout
+        sizes = self.sizes if sizes is None else tuple(sizes)
         while True:
             with self._cond:
                 while not self._closed and (
-                        self._paused or self._oldest_bucket() is None):
+                        self._paused or self._oldest_bucket(want) is None):
                     remaining = (None if deadline is None
                                  else deadline - self._clock())
                     if remaining is not None and remaining <= 0:
@@ -288,9 +304,9 @@ class BucketQueue:
                     self._cond.wait(timeout=remaining)
                 if self._closed:
                     return None
-                key = self._oldest_bucket()
+                key = self._oldest_bucket(want)
                 reqs = self._buckets[key]
-                k = pick_batch_size(len(reqs), self.sizes)
+                k = pick_batch_size(len(reqs), sizes)
                 batch, rest = reqs[:k], reqs[k:]
                 if rest:
                     self._buckets[key] = rest

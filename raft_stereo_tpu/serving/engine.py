@@ -109,7 +109,7 @@ from raft_stereo_tpu.config import (RaftStereoConfig, RequestTier,
                                     parse_tier)
 from raft_stereo_tpu.eval.runner import (early_exit_enabled,
                                          effective_inference_config,
-                                         make_forward)
+                                         make_forward, make_forward_mesh)
 from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 from raft_stereo_tpu.ops.padding import InputPadder
 from raft_stereo_tpu.serving.batcher import (BucketQueue, Overloaded,
@@ -145,6 +145,12 @@ FAMILY_STATE = "state"
 FAMILY_WARM = "warm"
 FAMILY_STATE_CTX = "state_ctx"
 FAMILY_WARM_CTX = "warm_ctx"
+# The xl family (round 17): a fixed-depth base-arity program SHARDED over
+# a rows/corr device-group mesh (eval/runner.make_forward_mesh) — one
+# full-resolution pair answered by several devices.  Only xl device-group
+# workers pop these groups (BucketQueue.pop ``want`` filter); executables
+# carry distinct ",mesh=rowsN" compile-cost and persist keys.
+FAMILY_XL = "xl"
 
 # Families that consume a flow_init input / reuse a context bundle.
 _WARM_FAMILIES = (FAMILY_WARM, FAMILY_WARM_CTX)
@@ -320,6 +326,56 @@ class ServeConfig:
     # percentile-clipped correlation-pyramid scales instead of dynamic
     # in-graph max-abs scales.  None = dynamic scales.
     quant_scales_path: Optional[str] = None
+    # ---- XL tier: mesh-sharded big-image serving (round 17) ------------
+    # Mesh topology one xl worker's bucket executables shard over, e.g.
+    # "rows=4" (image-row context parallelism through the WHOLE forward —
+    # the validated rows_gru loop) or "rows=2,corr=2" (rows-sharded
+    # encoders x disparity-sharded correlation volume).  One xl worker
+    # owns rows*corr devices (parallel.distributed.device_groups,
+    # allocated AFTER the data_parallel solo workers) and answers one
+    # request with all of them — per-device HBM drops ~1/N
+    # (ROWSGRU_MEMORY_r05.json: 141 GiB at rows=1 -> 13.8 GiB/device at
+    # 16 ways).  None (default): no xl tier; a replica whose device
+    # count cannot supply the mesh SKIPS the tier with a typed log line
+    # instead of failing at boot (compile-farm/fleet contract).  XL
+    # programs are fixed-depth, full-precision, and stateless (no
+    # sessions) — the early-exit/quant/warm knobs do not compose with
+    # the sharded executors (config.py).
+    xl_mesh: Optional[str] = None
+    # Independent xl device groups (each of rows*corr devices).
+    xl_workers: int = 1
+    # Requests whose padded BUCKET exceeds this many pixels route to the
+    # xl family automatically (clients can force any compatible request
+    # with ?tier=xl).  Default ~2 MP: about where a 32-iteration
+    # full-resolution pair stops being a sensible single-device dispatch
+    # (FULLRES_EVAL_r05.json: 16.5 s/image at 5.7 MP on one device).
+    xl_threshold_pixels: int = 2_000_000
+    # The mesh's own ceiling: buckets past this many pixels exceed what
+    # the declared device group can hold (size it from the mesh's
+    # measured per-device HBM at your largest warm bucket), so they fall
+    # through to halo-overlap tiling — "beyond any mesh still runs
+    # through the same bucket engine".  None = the mesh takes
+    # everything above the threshold.
+    xl_max_pixels: Optional[int] = None
+    # Batch ladder compiled per xl bucket; (1,) by default — megapixel
+    # pairs are latency-bound, and the mesh already uses the devices.
+    xl_batch_sizes: Tuple[int, ...] = (1,)
+    # ---- Halo-overlap tiling fallback (serving/tiles.py) ---------------
+    # Requests whose padded bucket exceeds this many pixels (and did not
+    # take the xl route) are split into equal-height overlapping row
+    # tiles, dispatched as ORDINARY bucket requests (tiles of one image
+    # share a bucket and batch together — no new scheduler), and
+    # stitched by center-crop; the measured tile disagreement lands in
+    # serve_tile_seam_epe and on the result (``ServeResult.seam_epe``).
+    # None (default): never tile.
+    tile_threshold_pixels: Optional[int] = None
+    # Owned rows per tile; each tile additionally carries tile_halo
+    # context rows on both sides (the per-iteration receptive-field
+    # margin the rows_gru halo-exchange contract sizes — tiling cannot
+    # refresh halos mid-loop, so it over-provisions 4x and measures the
+    # residual as seam error).
+    tile_rows: int = 512
+    tile_halo: int = 64
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -404,6 +460,43 @@ class ServeConfig:
                 raise ValueError(
                     f"ctx_cache_threshold={self.ctx_cache_threshold} "
                     f"must be > 0 (the static-scene gate)")
+        if self.xl_mesh is not None:
+            # Spec validity is a CONFIG error (fatal at construction);
+            # insufficient devices is a REPLICA condition (typed skip at
+            # engine boot) — the split the fleet contract needs.
+            from raft_stereo_tpu.parallel.mesh import parse_mesh_spec
+            parse_mesh_spec(self.xl_mesh)
+            if self.xl_workers < 1:
+                raise ValueError(f"xl_workers={self.xl_workers} must be "
+                                 f">= 1")
+            if self.xl_threshold_pixels < 1:
+                raise ValueError(f"xl_threshold_pixels="
+                                 f"{self.xl_threshold_pixels} must be "
+                                 f">= 1")
+            xl_sizes = tuple(sorted(set(int(s)
+                                        for s in self.xl_batch_sizes)))
+            if not xl_sizes or xl_sizes[0] != 1:
+                raise ValueError(
+                    f"xl_batch_sizes={self.xl_batch_sizes} must be "
+                    f"positive ints including 1 (the partial-batch "
+                    f"floor)")
+            if (self.xl_max_pixels is not None
+                    and self.xl_max_pixels <= self.xl_threshold_pixels):
+                raise ValueError(
+                    f"xl_max_pixels={self.xl_max_pixels} must exceed "
+                    f"xl_threshold_pixels={self.xl_threshold_pixels} "
+                    f"(the xl routing band would be empty)")
+        if self.tile_threshold_pixels is not None \
+                and self.tile_threshold_pixels < 1:
+            raise ValueError(f"tile_threshold_pixels="
+                             f"{self.tile_threshold_pixels} must be >= 1")
+        if self.tile_rows < MODEL_DIVIS:
+            raise ValueError(
+                f"tile_rows={self.tile_rows} must be >= {MODEL_DIVIS} "
+                f"(a tile is an ordinary /{MODEL_DIVIS}-padded bucket "
+                f"dispatch)")
+        if self.tile_halo < 0:
+            raise ValueError(f"tile_halo={self.tile_halo} must be >= 0")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -448,6 +541,15 @@ class ServeResult:
     # state_ctx frame computed, folded back into the session.
     ctx_cached: bool = False
     ctx: Optional[object] = None
+    # XL/tiling provenance (round 17): ``mesh`` — the compact mesh label
+    # ("rows4") when this request ran as a mesh-sharded xl dispatch
+    # (``tier`` reads "xl" then); ``tiles`` — how many halo-overlap tile
+    # dispatches a stitched answer rode (X-Tiles header); ``seam_epe`` —
+    # the tiles' measured mean overlap disagreement in px (None for
+    # untiled requests and single-overlap-free stitches).
+    mesh: Optional[str] = None
+    tiles: Optional[int] = None
+    seam_epe: Optional[float] = None
 
     @property
     def degraded(self) -> bool:
@@ -477,6 +579,38 @@ class _Payload:
     frame_delta: Optional[float] = None
     ctx_init: Optional[object] = None        # warm_ctx: the session's
     #                                          cached context bundle
+
+
+@dataclasses.dataclass
+class _XlGroup:
+    """One xl worker's device group: the mesh its bucket executables
+    shard over, the variables replicated onto it, and the replicated
+    NamedSharding the dispatch path uploads image buffers with."""
+
+    devices: Tuple
+    mesh: object          # jax.sharding.Mesh (1, corr, rows)
+    variables: object     # params replicated over the group
+    sharding: object      # NamedSharding(mesh, P()) for uploads
+
+    @property
+    def label(self) -> str:
+        return "+".join(str(getattr(d, "id", i))
+                        for i, d in enumerate(self.devices))
+
+
+@dataclasses.dataclass
+class _XlTier:
+    """Engine-side state of the xl serving tier (``ServeConfig.xl_mesh``):
+    the parsed topology, the model whose config carries the sharding
+    knobs (rows_shards / corr_w2_shards / rows_gru — same parameter tree
+    as the base model, different compiled programs), and the device
+    groups that serve it."""
+
+    spec: Dict[str, int]       # {"rows": r, "corr": c}
+    label: str                 # compact key/metric tag, e.g. "rows4"
+    size: int                  # devices per group (rows * corr)
+    model: RAFTStereo          # the xl-config model (shared params)
+    groups: List[_XlGroup]
 
 
 class BucketPolicy:
@@ -608,12 +742,18 @@ class ServingEngine:
         self.tracer = (tracer if tracer is not None
                        else SpanTracer(serve_cfg.trace_sample_rate))
         if devices is None:
-            local = jax.local_devices()
-            if serve_cfg.data_parallel > len(local):
+            # The ONE device-discovery helper the engine and the parallel
+            # runtime share (parallel/distributed.py): a stable id-sorted
+            # order, so the solo worker pool and the xl mesh groups below
+            # partition the same list instead of each trusting
+            # jax.local_devices() ordering independently.
+            from raft_stereo_tpu.parallel.distributed import device_groups
+            solo = device_groups(1, serve_cfg.data_parallel)
+            if not solo:
                 raise ValueError(
                     f"data_parallel={serve_cfg.data_parallel} exceeds the "
-                    f"{len(local)} local devices")
-            devices = local[:serve_cfg.data_parallel]
+                    f"{len(jax.local_devices())} local devices")
+            devices = [g[0] for g in solo]
         self.devices = list(devices)
         self.metrics = ServingMetrics(registry,
                                       max_batch=serve_cfg.max_batch)
@@ -712,6 +852,19 @@ class ServingEngine:
             max_batch=serve_cfg.max_batch,
             batch_sizes=serve_cfg.batch_sizes,
             max_queue=serve_cfg.max_queue, metrics=self.metrics)
+        # ---- XL tier: mesh-sharded device groups (round 17) ------------
+        # ``self.xl`` is an _XlTier (mesh spec + per-group meshes +
+        # replicated variables) or None — None either because no xl_mesh
+        # was configured or because THIS replica cannot supply the
+        # devices (typed skip; the fleet contract for heterogeneous
+        # replicas).  xl workers are extra entries at the END of the
+        # unified worker table: indices [len(devices), len(devices) +
+        # xl_workers) with their own breakers/threads, popping only
+        # FAMILY_XL groups from the one shared queue.
+        self.xl: Optional[_XlTier] = None
+        self._xl_sizes: Tuple[int, ...] = ()
+        if serve_cfg.xl_mesh is not None:
+            self._init_xl(variables)
         # ---- Resilience layer (round 13) -------------------------------
         # Anomaly sink (telemetry/watchdog.AnomalySink | None): fires
         # worker_crash / circuit / brownout / poisoned events into the
@@ -729,15 +882,16 @@ class ServingEngine:
                 observe=self.metrics.observe_injected_fault)
             log.warning("CHAOS ENABLED: %s — injected faults are ON for "
                         "this engine", serve_cfg.chaos)
-        # Per-device circuit breakers; gauges start in the closed state
-        # so /metrics shows every device's circuit from boot.
+        # Per-worker circuit breakers (solo devices AND xl device
+        # groups); gauges start in the closed state so /metrics shows
+        # every worker's circuit from boot.
         self.breakers = [
             CircuitBreaker(
                 failure_threshold=serve_cfg.breaker_failures,
                 cooldown_s=serve_cfg.breaker_cooldown_s,
                 on_state=self._make_circuit_callback(i))
-            for i in range(len(self.devices))]
-        for i in range(len(self.devices)):
+            for i in range(self._worker_count())]
+        for i in range(self._worker_count()):
             self.metrics.circuit_gauge(i).set(CIRCUIT_CLOSED)
         # Brownout controller over the tier cost ladder (cheapest-first).
         self.brownout: Optional[BrownoutController] = None
@@ -790,6 +944,15 @@ class ServingEngine:
         self._warm_target: set = set()
         for hw in serve_cfg.warmup_shapes:
             hp, wp, _ = self.policy.bucket_for(int(hw[0]), int(hw[1]))
+            if self._xl_routes((hp, wp)):
+                # This bucket's traffic runs on the xl mesh groups —
+                # warming the solo ladder for it would pay megapixel
+                # single-device compiles no request will ever dispatch.
+                for widx in self._xl_worker_indices():
+                    for n in self._xl_sizes:
+                        self._warm_target.add(
+                            (widx, (hp, wp), n, None, FAMILY_XL))
+                continue
             for widx in range(len(self.devices)):
                 for tier in self._distinct_cache_tiers():
                     for n in self.queue.sizes:
@@ -802,7 +965,7 @@ class ServingEngine:
         self._workers = [
             threading.Thread(target=self._worker_loop, args=(i,),
                              daemon=True, name=f"stereo-worker-{i}")
-            for i in range(len(self.devices))]
+            for i in range(self._worker_count())]
         for t in self._workers:
             t.start()
         if serve_cfg.prewarm_on_init:
@@ -831,6 +994,183 @@ class ServingEngine:
         transitions emit anomaly run events + flight-recorder bundles
         through the same path the watchdogs use."""
         self.sink = sink
+
+    # -------------------------------------------------------------- xl tier
+    def _xl_model_config(self, spec: Dict[str, int]) -> RaftStereoConfig:
+        """The model config xl bucket executables compile: the engine's
+        effective config with the mesh sharding knobs swapped in.
+        Raises typed ``ValueError`` at BOOT for architecture/mesh
+        combinations the sharded executors do not support — a
+        misdeclared xl tier must fail loudly at construction, not at the
+        first megapixel request."""
+        base = self.effective_config
+        rows, corr = spec["rows"], spec["corr"]
+        if corr > 1 and base.corr_backend == "alt":
+            raise ValueError(
+                "xl_mesh corr sharding shards the 'reg' correlation "
+                "volume and is incompatible with corr_backend='alt' "
+                "(which builds no volume) — use 'reg'/'reg_fused' or a "
+                "rows-only mesh")
+        if rows > 1:
+            from raft_stereo_tpu.models.banded import banded_supported
+            norms = (base.context_norm,) + (
+                () if base.shared_backbone else (base.fnet_norm,))
+            for norm in norms:
+                if not banded_supported(norm, base.n_downsample):
+                    raise ValueError(
+                        f"xl_mesh rows sharding is unsupported for this "
+                        f"architecture: norm {norm!r} with n_downsample="
+                        f"{base.n_downsample} (parallel/rows_sharded.py "
+                        f"supports the published n_downsample=2 trunks)")
+        # Fixed-depth, full-precision, unbanded: the sharded executors
+        # run their own paths and the early-exit / int8 / banded knobs
+        # do not compose with them (config.py validation); rows_gru
+        # (full-loop context parallelism) needs the volume unsharded,
+        # so a combined rows x corr mesh shards encoders + volume and
+        # leaves the GRU loop replicated (the MULTICHIP_r05 dryrun
+        # topology).
+        return dataclasses.replace(
+            base, rows_shards=rows, corr_w2_shards=corr,
+            rows_gru=(rows > 1 and corr == 1), banded_encoder=False,
+            exit_threshold_px=0.0, exit_max_iters=None,
+            quant="off", quant_corr_scales=None)
+
+    def _init_xl(self, variables) -> None:
+        """Build the xl tier: parse the mesh spec, carve device groups
+        from the stable local-device order (after the solo workers),
+        and replicate the variables onto each group's mesh.  A replica
+        whose devices cannot supply the mesh logs the typed skip line
+        and serves WITHOUT the tier (xl-routed requests fall through to
+        tiling / solo dispatch) — fleet replicas are allowed to be
+        heterogeneous."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from raft_stereo_tpu.parallel.distributed import device_groups
+        from raft_stereo_tpu.parallel.mesh import (make_mesh,
+                                                   mesh_spec_label,
+                                                   parse_mesh_spec)
+
+        serve_cfg = self.serve_cfg
+        spec = parse_mesh_spec(serve_cfg.xl_mesh)
+        size = spec["rows"] * spec["corr"]
+        xl_cfg = self._xl_model_config(spec)   # raises typed on bad combos
+        groups_devs = device_groups(size, serve_cfg.xl_workers,
+                                    skip=len(self.devices))
+        if not groups_devs:
+            # Not enough devices past the solo workers: overlap with them
+            # rather than refuse — dispatches contend on the shared
+            # devices but stay correct (the CPU test backend and small
+            # dev hosts hit this; production sizes data_parallel +
+            # xl_workers*size <= local devices).
+            groups_devs = device_groups(size, serve_cfg.xl_workers)
+            if groups_devs:
+                log.warning(
+                    "xl_mesh=%s: not enough devices after the %d solo "
+                    "worker(s) — xl group(s) share their devices "
+                    "(dispatches contend; size data_parallel + "
+                    "xl_workers*%d <= %d local devices to avoid this)",
+                    serve_cfg.xl_mesh, len(self.devices), size,
+                    len(jax.local_devices()))
+        if not groups_devs:
+            log.warning(
+                "xl_mesh=%s skipped: this replica has %d local "
+                "device(s) but the mesh needs %d x %d worker group(s) "
+                "— serving WITHOUT the xl tier (big requests fall back "
+                "to tiling / solo dispatch)", serve_cfg.xl_mesh,
+                len(jax.local_devices()), size, serve_cfg.xl_workers)
+            return
+        label = mesh_spec_label(spec)
+        model = (self.model if xl_cfg == self.effective_config
+                 else RAFTStereo(xl_cfg))
+        groups = []
+        for devs in groups_devs:
+            mesh = make_mesh(n_data=1, n_corr=spec["corr"],
+                             n_rows=spec["rows"], devices=devs)
+            repl = NamedSharding(mesh, P())
+            groups.append(_XlGroup(
+                devices=tuple(devs), mesh=mesh,
+                variables=jax.device_put(self._host_variables, repl),
+                sharding=repl))
+        self.xl = _XlTier(spec=spec, label=label, size=size, model=model,
+                          groups=groups)
+        self._xl_sizes = tuple(sorted(set(
+            int(s) for s in serve_cfg.xl_batch_sizes)))
+        log.info("xl tier up: mesh %s (%s), %d group(s) of %d device(s), "
+                 "routing buckets > %d px (and ?tier=xl)",
+                 serve_cfg.xl_mesh, label, len(groups), size,
+                 serve_cfg.xl_threshold_pixels)
+
+    @property
+    def xl_enabled(self) -> bool:
+        return self.xl is not None
+
+    def _worker_count(self) -> int:
+        return len(self.devices) + (len(self.xl.groups)
+                                    if self.xl is not None else 0)
+
+    def _is_xl_worker(self, widx: int) -> bool:
+        return widx >= len(self.devices)
+
+    def _xl_group(self, widx: int) -> _XlGroup:
+        return self.xl.groups[widx - len(self.devices)]
+
+    def _xl_worker_indices(self) -> List[int]:
+        if self.xl is None:
+            return []
+        return list(range(len(self.devices),
+                          len(self.devices) + len(self.xl.groups)))
+
+    def _xl_compatible(self, bucket: Tuple[int, int]
+                       ) -> Tuple[bool, str]:
+        """Whether this padded bucket satisfies the xl mesh's geometry
+        (trunk row divisibility, rows_gru window constraints).  The /32
+        pad guarantees most production shapes pass; the ones that don't
+        fall through to tiling with the reason logged."""
+        if self.xl is None:
+            return False, "no xl mesh on this engine"
+        cfg = self.xl.model.config
+        rows = cfg.rows_shards
+        h = int(bucket[0])
+        if rows > 1:
+            if h % (4 * rows):
+                return False, (f"padded H={h} not divisible by 4*rows="
+                               f"{4 * rows} (stride-2 trunk stages)")
+            from raft_stereo_tpu.parallel.rows_sharded import DEFAULT_HALO
+            if h // rows < DEFAULT_HALO:
+                return False, (f"per-shard rows H/rows={h // rows} < "
+                               f"trunk halo {DEFAULT_HALO}")
+            if cfg.rows_gru:
+                from raft_stereo_tpu.parallel.rows_gru import \
+                    validate_rows_gru
+                try:
+                    validate_rows_gru(cfg, h // cfg.downsample_factor,
+                                      rows)
+                except ValueError as e:
+                    return False, str(e)
+        return True, ""
+
+    def _xl_routes(self, bucket: Tuple[int, int]) -> bool:
+        """Whether a stateless request at this bucket routes to the xl
+        family automatically (the prewarm/readiness surface uses the
+        same predicate, so the warm target matches real routing)."""
+        px = bucket[0] * bucket[1]
+        return (self.xl is not None
+                and px > self.serve_cfg.xl_threshold_pixels
+                and (self.serve_cfg.xl_max_pixels is None
+                     or px <= self.serve_cfg.xl_max_pixels)
+                and self._xl_compatible(bucket)[0])
+
+    def xl_status(self) -> Optional[Dict[str, object]]:
+        """One JSON-able line for /healthz: the tier's topology and
+        routing threshold, or None when this engine serves without it."""
+        if self.xl is None:
+            return None
+        return {"mesh": self.serve_cfg.xl_mesh, "label": self.xl.label,
+                "groups": len(self.xl.groups),
+                "devices_per_group": self.xl.size,
+                "threshold_pixels": self.serve_cfg.xl_threshold_pixels,
+                "batch_sizes": list(self._xl_sizes)}
 
     # ----------------------------------------------------------- back-compat
     @property
@@ -876,14 +1216,54 @@ class ServingEngine:
         the X-No-Degrade header here), and ``brownout_exempt_tiers``
         opts a whole tier out; a degraded result carries
         ``requested_tier`` / ``degraded``.
+
+        Big-image routing (round 17): with an xl tier configured
+        (``ServeConfig.xl_mesh``), a request whose padded bucket exceeds
+        ``xl_threshold_pixels`` — or that names ``tier="xl"`` explicitly
+        — dispatches ONE mesh-sharded executable on an xl device group
+        (result ``tier`` reads "xl", ``mesh`` carries the topology
+        label).  Past ``tile_threshold_pixels`` (or when the bucket does
+        not fit the mesh geometry) the request is answered by
+        halo-overlap tiling instead: equal-height row tiles ride the
+        ordinary batcher and the stitched result carries ``tiles`` /
+        ``seam_epe``.  Naming ``tier="xl"`` without an xl tier, or for
+        a mesh-incompatible bucket, raises ``ValueError`` (HTTP 400).
         """
         t_admit = time.perf_counter()
-        tier, requested_tier = self._admit_tier(tier, degradable)
         left, right = np.asarray(left), np.asarray(right)
         if left.ndim != 3 or left.shape != right.shape:
             raise ValueError(
                 f"need two same-shape (H, W, 3) images, got {left.shape} "
                 f"vs {right.shape}")
+        bucket = self.policy.bucket_for(left.shape[0], left.shape[1])[:2]
+        want_xl = tier == "xl"
+        if want_xl and self.xl is None:
+            raise ValueError(
+                "tier 'xl' requested but this engine has no xl tier "
+                "(configure ServeConfig.xl_mesh / --xl_mesh, and enough "
+                "devices for the mesh)")
+        if self.xl is not None and (want_xl or self._xl_routes(bucket)):
+            ok, reason = self._xl_compatible(bucket)
+            if ok:
+                # Fixed-depth full-precision program: no tier ladder, no
+                # brownout rung below it — the request IS the expensive
+                # kind brownout protects the rest of the fleet from.
+                return self._enqueue(left, right, deadline_ms, None,
+                                     None, t_admit,
+                                     family=FAMILY_XL).future
+            if want_xl:
+                raise ValueError(
+                    f"tier 'xl': bucket {bucket[0]}x{bucket[1]} does "
+                    f"not fit mesh {self.serve_cfg.xl_mesh}: {reason}")
+            log.info("bucket %sx%s exceeds xl_threshold_pixels but does "
+                     "not fit mesh %s (%s) — falling through to "
+                     "tiling/solo dispatch", bucket[0], bucket[1],
+                     self.serve_cfg.xl_mesh, reason)
+        tier, requested_tier = self._admit_tier(tier, degradable)
+        tt = self.serve_cfg.tile_threshold_pixels
+        if tt is not None and bucket[0] * bucket[1] > tt:
+            return self._submit_tiled(left, right, deadline_ms, tier,
+                                      requested_tier, t_admit)
         return self._enqueue(left, right, deadline_ms, tier,
                              requested_tier, t_admit).future
 
@@ -986,6 +1366,97 @@ class ServingEngine:
         """Blocking convenience: submit + wait (the in-process client)."""
         return self.submit(left, right, deadline_ms, tier=tier,
                            degradable=degradable).result(timeout=timeout)
+
+    # ------------------------------------------------------ tiled dispatch
+    def _submit_tiled(self, left: np.ndarray, right: np.ndarray,
+                      deadline_ms: Optional[float], tier: Optional[str],
+                      requested_tier: Optional[str],
+                      t_admit: float) -> Future:
+        """Answer one beyond-threshold pair as N halo-overlap row tiles
+        through the ORDINARY bucket path (serving/tiles.py): every tile
+        is an equal-height `_enqueue` at the same bucket/tier/family, so
+        the continuous batcher coalesces them into batch-N dispatches —
+        no new scheduler.  The returned Future resolves once every tile
+        did, with the center-crop-stitched disparity and the measured
+        seam error.  A tile failing (deadline, poisoning, shutdown)
+        fails the whole request with that tile's typed error.  An
+        ``Overloaded`` mid-tiling propagates to the caller; tiles
+        admitted before the bound hit still run and are discarded (their
+        futures resolve into a dead aggregate) — admission stays a
+        single bounded door, unreserved."""
+        from raft_stereo_tpu.serving import tiles as tiles_mod
+
+        specs = tiles_mod.plan_tiles(left.shape[0],
+                                     self.serve_cfg.tile_rows,
+                                     self.serve_cfg.tile_halo)
+        if len(specs) < 2:
+            # Shorter than one tile extent: nothing to split.
+            return self._enqueue(left, right, deadline_ms, tier,
+                                 requested_tier, t_admit).future
+        reqs = [self._enqueue(
+                    np.ascontiguousarray(left[s.src0:s.src1]),
+                    np.ascontiguousarray(right[s.src0:s.src1]),
+                    deadline_ms, tier, requested_tier, t_admit)
+                for s in specs]
+        agg: Future = Future()
+        state = {"remaining": len(reqs), "done": False}
+        lock = threading.Lock()
+
+        def on_done(future):
+            # One-shot resolution decided INSIDE the lock: the first
+            # failing tile owns the aggregate; later tiles (including
+            # other failures) are no-ops.
+            action = None
+            with lock:
+                if state["done"]:
+                    return
+                if future.exception() is not None:
+                    state["done"], action = True, "fail"
+                else:
+                    state["remaining"] -= 1
+                    if state["remaining"] == 0:
+                        state["done"], action = True, "finish"
+            if action == "fail":
+                agg.set_exception(future.exception())
+            elif action == "finish":
+                try:
+                    self._finish_tiled(agg, reqs, specs, tier,
+                                       requested_tier, t_admit)
+                except BaseException as e:  # noqa: BLE001 — typed to caller
+                    agg.set_exception(e)
+
+        for req in reqs:
+            req.future.add_done_callback(on_done)
+        return agg
+
+    def _finish_tiled(self, agg: Future, reqs: List[Request],
+                      specs, tier: Optional[str],
+                      requested_tier: Optional[str],
+                      t_admit: float) -> None:
+        """All tiles answered: stitch, measure the seam, resolve the
+        aggregate.  Latency legs report the worst tile (the tiles ran
+        concurrently); ``total_s`` is admission -> stitched."""
+        from raft_stereo_tpu.serving import tiles as tiles_mod
+
+        results = [r.future.result() for r in reqs]
+        flow = tiles_mod.stitch([res.flow for res in results], specs)
+        seam = tiles_mod.seam_epe([res.flow for res in results], specs)
+        self.metrics.tiled_requests.inc()
+        if seam is not None:
+            self.metrics.tile_seam_epe.observe(seam)
+        iters = [res.iters_used for res in results
+                 if res.iters_used is not None]
+        agg.set_result(ServeResult(
+            flow=np.ascontiguousarray(flow),
+            queue_wait_s=max(res.queue_wait_s for res in results),
+            device_s=max(res.device_s for res in results),
+            fetch_s=max(res.fetch_s for res in results),
+            total_s=time.perf_counter() - t_admit,
+            batch_size=max(res.batch_size for res in results),
+            iters_used=max(iters) if iters else None,
+            tier=tier, requested_tier=requested_tier,
+            attempts=max(res.attempts for res in results),
+            tiles=len(reqs), seam_epe=seam))
 
     # ---------------------------------------------------- streaming sessions
     def submit_session(self, session_id: str, left: np.ndarray,
@@ -1222,6 +1693,11 @@ class ServingEngine:
         the resident fp32 tree for full-precision tiers, the per-worker
         int8 tree for quant tiers (built lazily, host-quantized once per
         engine — disk checkpoints stay fp32)."""
+        if self._is_xl_worker(widx):
+            # xl workers consume the tree replicated over their group's
+            # mesh (one host->devices placement per group at boot);
+            # tiers never apply there — xl is fixed-depth fp.
+            return self._xl_group(widx).variables
         if self._tier_models[cache_tier].config.quant == "off":
             return self._worker_vars[widx]
         import jax
@@ -1284,6 +1760,14 @@ class ServingEngine:
         warm/state split): an int8 tier's executable must never share a
         cost record with the full-precision program of the same
         (bucket, batch)."""
+        if family == FAMILY_XL:
+            # The mesh label IS the family coordinate for xl (the
+            # ISSUE's ",mesh=rows4" contract): an xl executable must
+            # never share a cost record with the solo program of the
+            # same (bucket, batch).
+            label = self.xl.label if self.xl is not None else "none"
+            return (f"serving.forward({bucket[0]}x{bucket[1]},b{batch}"
+                    f",mesh={label})")
         cache_tier = self._cache_tier(tier)
         tail = "" if cache_tier is None else f",tier={tier}"
         qmode = self._tier_models[cache_tier].config.quant
@@ -1318,14 +1802,25 @@ class ServingEngine:
                 return self._compiled[key]
         # Build + (with cost telemetry) AOT-instrument outside the lock —
         # distinct keys may compile concurrently on different workers.
-        fwd = make_forward(self._tier_models[tier], self.serve_cfg.iters,
-                           self._fetch_jax_dtype(),
-                           donate_images=self.serve_cfg.donate_buffers,
-                           warm_start=(family in _WARM_FAMILIES),
-                           return_state=(family is not FAMILY_BASE),
-                           ctx=("save" if family == FAMILY_STATE_CTX
-                                else "reuse" if family == FAMILY_WARM_CTX
-                                else None))
+        if family == FAMILY_XL:
+            # The mesh-sharded program over this worker's device group
+            # (eval/runner.make_forward_mesh); base arity, fixed depth.
+            fwd = make_forward_mesh(
+                self.xl.model, self.serve_cfg.iters,
+                self._xl_group(worker).mesh,
+                self._fetch_jax_dtype(),
+                donate_images=self.serve_cfg.donate_buffers)
+        else:
+            fwd = make_forward(
+                self._tier_models[tier], self.serve_cfg.iters,
+                self._fetch_jax_dtype(),
+                donate_images=self.serve_cfg.donate_buffers,
+                warm_start=(family in _WARM_FAMILIES),
+                return_state=(family is not FAMILY_BASE
+                              and family != FAMILY_XL),
+                ctx=("save" if family == FAMILY_STATE_CTX
+                     else "reuse" if family == FAMILY_WARM_CTX
+                     else None))
         if self.disk_cache is not None:
             fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
                                         family)
@@ -1371,6 +1866,22 @@ class ServingEngine:
         (config, bucket, batch, tier)."""
         from raft_stereo_tpu.serving.persist import executable_cache_key
 
+        if family == FAMILY_XL:
+            # The xl coordinates: the sharded config JSON (rows_shards /
+            # corr_w2_shards / rows_gru live inside it), the explicit
+            # mesh label (belt and braces, like quant below), and the
+            # WHOLE device group — a serialized sharded executable is
+            # bound to its device assignment, so groups never share an
+            # entry.
+            group = self._xl_group(worker)
+            return executable_cache_key(
+                config=self.xl.model.config.to_json(),
+                bucket=tuple(bucket), batch=int(batch),
+                tier=None, iters=self.serve_cfg.iters,
+                fetch_dtype=self.serve_cfg.fetch_dtype,
+                donate=self.serve_cfg.donate_buffers,
+                family=FAMILY_XL, flow_init=False,
+                mesh=self.xl.label, device=group.label)
         return executable_cache_key(
             config=self._tier_models[cache_tier].config.to_json(),
             bucket=tuple(bucket), batch=int(batch),
@@ -1415,7 +1926,8 @@ class ServingEngine:
         aval = jax.ShapeDtypeStruct((batch, bucket[0], bucket[1], 3),
                                     np.uint8)
         avals = [aval, aval]
-        tier_cfg = self._tier_models[cache_tier].config
+        tier_cfg = (self.xl.model.config if family == FAMILY_XL
+                    else self._tier_models[cache_tier].config)
         if family in _WARM_FAMILIES:
             f = tier_cfg.downsample_factor
             avals.append(jax.ShapeDtypeStruct(
@@ -1447,7 +1959,9 @@ class ServingEngine:
             meta={"bucket": list(bucket), "batch": int(batch),
                   "tier": cache_tier, "family": family,
                   "iters": self.serve_cfg.iters,
-                  "quant": self._tier_models[cache_tier].config.quant,
+                  "quant": tier_cfg.quant,
+                  "mesh": (self.xl.label if family == FAMILY_XL
+                           else None),
                   "fetch_dtype": self.serve_cfg.fetch_dtype,
                   "compile_s": round(compile_s, 3)})
         return compiled
@@ -1477,6 +1991,12 @@ class ServingEngine:
 
         h, w = int(raw_hw[0]), int(raw_hw[1])
         hp, wp, _ = self.policy.bucket_for(h, w)
+        if self._xl_routes((hp, wp)):
+            # This bucket's traffic dispatches on the xl mesh groups —
+            # warm THAT surface (and only it; the solo ladder at this
+            # size would compile programs no request runs).
+            self._prewarm_xl((hp, wp), batch_sizes)
+            return
         sizes = tuple(batch_sizes) if batch_sizes else self.queue.sizes
         if tiers is None:
             cache_tiers = self._distinct_cache_tiers()
@@ -1517,6 +2037,42 @@ class ServingEngine:
                  "y" if len(cache_tiers) == 1 else "ies",
                  len(self._families()), len(self.devices))
 
+    def _prewarm_xl(self, bucket: Tuple[int, int],
+                    batch_sizes: Optional[Sequence[int]] = None) -> None:
+        """Compile + warm the xl bucket ladder on every xl device group:
+        each batch size dispatches once with zero images through the
+        mesh-sharded program, the warm entries open /readyz, and (with
+        cost telemetry) the per-device HBM gauge goes live."""
+        import jax
+
+        sizes = tuple(batch_sizes) if batch_sizes else self._xl_sizes
+        for widx in self._xl_worker_indices():
+            group = self._xl_group(widx)
+            for n in sizes:
+                fwd = self._forward_for(bucket, n, worker=widx,
+                                        tier=None, family=FAMILY_XL)
+                zeros = np.zeros((n, bucket[0], bucket[1], 3), np.uint8)
+                out = fwd(group.variables,
+                          jax.device_put(zeros, group.sharding),
+                          jax.device_put(zeros.copy(), group.sharding))
+                jax.block_until_ready(out)
+                self._note_warm(widx, bucket, n, None, FAMILY_XL)
+                self._note_xl_hbm(bucket, n)
+        log.info("prewarmed XL bucket %dx%d batch sizes %s (mesh %s) on "
+                 "%d device group(s)", bucket[0], bucket[1], sizes,
+                 self.xl.label, len(self.xl.groups))
+
+    def _note_xl_hbm(self, bucket: Tuple[int, int], batch: int) -> None:
+        """Surface the xl executable's per-device HBM (CompileRecord
+        memory_analysis) as serve_xl_hbm_bytes{mesh=,bucket=} — the
+        sharding win as a live gauge.  No-op without cost telemetry or
+        when the backend's analysis degraded."""
+        rec = self.compiled_cost(bucket, batch=batch, family=FAMILY_XL)
+        if rec is not None and rec.hbm_bytes:
+            self.metrics.xl_hbm_gauge(
+                self.xl.label, f"{bucket[0]}x{bucket[1]}"
+            ).set(rec.hbm_bytes)
+
     # --------------------------------------------------------------- workers
     def _worker_loop(self, widx: int) -> None:
         """One device worker under supervision.  The circuit breaker
@@ -1526,6 +2082,17 @@ class ServingEngine:
         the server, and a fresh thread is the cheapest guarantee that no
         corrupted per-thread state survives the crash."""
         breaker = self.breakers[widx]
+        # Worker-class pop filter: xl device-group workers take ONLY the
+        # mesh-sharded xl groups (their own batch ladder); solo workers
+        # take everything else.  One queue, one admission bound, one
+        # drain — the filter is the whole scheduler change.
+        want, sizes = None, None
+        if self.xl is not None:
+            if self._is_xl_worker(widx):
+                want = lambda key: key[2] == FAMILY_XL  # noqa: E731
+                sizes = self._xl_sizes
+            else:
+                want = lambda key: key[2] != FAMILY_XL  # noqa: E731
         while True:
             delay = breaker.until_allowed()
             if delay > 0:
@@ -1533,7 +2100,7 @@ class ServingEngine:
                     return
                 time.sleep(min(delay, 0.05))
                 continue
-            batch = self.queue.pop()
+            batch = self.queue.pop(want=want, sizes=sizes)
             if batch is None:       # queue closed: worker shutdown
                 return
             try:
@@ -1676,21 +2243,30 @@ class ServingEngine:
         deadline triage can shrink a batch below the size it picked —
         decompose so every device dispatch still runs a compiled
         batch-size bucket."""
+        sizes = (self._xl_sizes if batch[0].family == FAMILY_XL
+                 else self.queue.sizes)
         i = 0
-        for k in decompose_batch(len(batch), self.queue.sizes):
+        for k in decompose_batch(len(batch), sizes):
             self._run_chunk(widx, batch[i:i + k])
             i += k
 
     def _run_chunk(self, widx: int, batch: List[Request]) -> None:
         import jax
 
-        device = self.devices[widx]
         t_pickup = time.monotonic()
         waits = [t_pickup - r.t_enqueue for r in batch]
         bucket = batch[0].bucket
         tier = batch[0].tier       # queue groups by (bucket, tier, family)
         family = batch[0].family
         n = len(batch)
+        xl = family == FAMILY_XL
+        if xl:
+            group = self._xl_group(widx)
+            device = group.sharding   # replicated upload over the mesh
+            device_label = f"xl:{group.label}"
+        else:
+            device = self.devices[widx]
+            device_label = str(device)
 
         # Sampled requests: the queue leg ends at worker pickup; the
         # dispatch/fetch spans below share the chunk's time window but land
@@ -1719,7 +2295,7 @@ class ServingEngine:
             # work across a real batch axis with zero filler frames.
             fwd = self._forward_for(bucket, n, worker=widx, tier=tier,
                                     family=family)
-            adaptive = early_exit_enabled(
+            adaptive = False if xl else early_exit_enabled(
                 self._tier_models[self._cache_tier(tier)].config)
             p1 = np.stack([r.payload.left for r in batch])
             p2 = np.stack([r.payload.right for r in batch])
@@ -1759,7 +2335,7 @@ class ServingEngine:
                 import jax.tree_util as jtu
                 out, ctx_dev = out[:-1], out[-1]
                 ctx_out = jtu.tree_map(lambda x: np.asarray(x), ctx_dev)
-            if family is FAMILY_BASE:
+            if family is FAMILY_BASE or xl:
                 if adaptive:
                     flows, iters_used_dev = out
                     iters_used = int(iters_used_dev)  # extra scalar fetch
@@ -1781,7 +2357,7 @@ class ServingEngine:
         for r in sampled:
             self.tracer.add_span(
                 "serve.dispatch", r.trace, p_pickup, p_ready,
-                bucket=str(bucket), batch_size=n, device=str(device),
+                bucket=str(bucket), batch_size=n, device=device_label,
                 iters_used=iters_used, attempt=r.attempts + 1,
                 **({"tier": tier} if tier is not None else {}))
             self.tracer.add_span("serve.fetch", r.trace, p_ready, p_fetched,
@@ -1790,13 +2366,16 @@ class ServingEngine:
         device_s = t_ready - t_pickup
         fetch_s = t_fetched - t_ready
         self.metrics.observe_dispatch(n)
+        if xl:
+            self.metrics.xl_dispatches.inc()
+            self._note_xl_hbm(bucket, n)
         # Trip-count telemetry: every dispatch lands in the per-tier
         # infer_gru_iters_used histogram (fixed-depth paths report the
         # configured depth, so tier histograms are directly comparable)
         # and early-exit dispatches accumulate the iterations they saved.
         self.metrics.observe_iters_used(
-            tier or "default", iters_used, self.serve_cfg.iters,
-            n_requests=n)
+            "xl" if xl else (tier or "default"), iters_used,
+            self.serve_cfg.iters, n_requests=n)
         self.metrics.device_time.observe(device_s)
         self.metrics.fetch_time.observe(fetch_s)
         # Padding-waste accounting + the policy feedback loop: every
@@ -1817,7 +2396,8 @@ class ServingEngine:
         # by the observed iters_used for honest per-phase MFU
         # (cost_report --observed_iters).
         if self._mfu is not None:
-            rec = self.compiled_cost(bucket, batch=n, tier=tier)
+            rec = self.compiled_cost(bucket, batch=n, tier=tier,
+                                     family=family)
             if rec is not None and rec.flops:
                 self.metrics.dispatched_flops.inc(rec.flops)
                 self._mfu.note(rec.flops)
@@ -1843,7 +2423,9 @@ class ServingEngine:
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
-                batch_size=n, iters_used=iters_used, tier=tier,
+                batch_size=n, iters_used=iters_used,
+                tier="xl" if xl else tier,
+                mesh=self.xl.label if xl else None,
                 requested_tier=r.requested_tier, attempts=r.attempts + 1,
                 session_id=r.session_id,
                 frame_index=r.payload.frame_index,

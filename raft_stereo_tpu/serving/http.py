@@ -218,7 +218,8 @@ def make_handler(service: StereoService,
                     "sessions_active": (
                         service.sessions.active_count
                         if service.sessions is not None else None),
-                    "devices": len(service.devices)})
+                    "devices": len(service.devices),
+                    "xl": service.xl_status()})
             elif path == "/readyz":
                 status = service.warm_status()
                 status["status"] = ("ready" if status["ready"]
@@ -292,7 +293,23 @@ def make_handler(service: StereoService,
                     raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
                 tier = query.get("tier", [None])[0] or \
                     self.headers.get("X-Tier")
-                if tier is not None:
+                if tier == "xl":
+                    # The xl pseudo-tier routes to the mesh-sharded
+                    # family (serving/engine.py submit); valid only on
+                    # an engine with an xl tier and a mesh-compatible
+                    # bucket — the engine raises ValueError (-> 400)
+                    # otherwise.
+                    if getattr(service, "xl", None) is None:
+                        raise ValueError(
+                            "tier 'xl': this server has no xl mesh "
+                            "tier (start raft-serve with --xl_mesh)")
+                    if session_id is not None:
+                        raise ValueError(
+                            "tier 'xl': streaming sessions are "
+                            "single-device — the warm/ctx state "
+                            "machinery does not compose with the "
+                            "mesh-sharded program")
+                elif tier is not None:
                     service.resolve_tier(tier)  # 400 on unknown tiers
                 degradable = self.headers.get("X-No-Degrade") is None
             except (ValueError, KeyError, OSError) as e:
@@ -356,6 +373,13 @@ def make_handler(service: StereoService,
                 headers.append(("X-Iters-Used", str(result.iters_used)))
             if result.tier is not None:
                 headers.append(("X-Tier", result.tier))
+            if result.mesh is not None:
+                headers.append(("X-Mesh", result.mesh))
+            if result.tiles is not None:
+                headers.append(("X-Tiles", str(result.tiles)))
+                if result.seam_epe is not None:
+                    headers.append(("X-Seam-EPE",
+                                    f"{result.seam_epe:.4f}"))
             if result.degraded:
                 headers.append(("X-Degraded",
                                 f"{result.requested_tier}->{result.tier}"))

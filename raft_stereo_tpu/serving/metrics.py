@@ -19,7 +19,8 @@ from raft_stereo_tpu.telemetry.registry import (  # noqa: F401 — re-exports
     DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
 
 __all__ = ["DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "ServingMetrics", "PADDING_WASTE_BUCKETS"]
+           "MetricsRegistry", "ServingMetrics", "PADDING_WASTE_BUCKETS",
+           "SEAM_EPE_BUCKETS"]
 
 # Waste-fraction buckets for serve_padding_waste: fraction of dispatched
 # pixels that were padding (0 = every pixel real).  KITTI's /32 pad wastes
@@ -32,6 +33,12 @@ PADDING_WASTE_BUCKETS = (0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
 # seconds.  Covers the realtime depth (7), the accuracy depth (32), and
 # headroom past it.
 ITERS_USED_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+
+# Seam-error buckets for serve_tile_seam_epe: mean |Δdisparity| (px)
+# between adjacent tiles' predictions on their overlap rows
+# (serving/tiles.py).  Consistent tiles sit at ~0; values past ~1 px mean
+# the halo is not carrying enough vertical context for this content.
+SEAM_EPE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0)
 
 # Inter-frame delta buckets for serve_session_frame_delta: mean
 # |Δintensity| (0..255) between consecutive frames' thumbnails.  Video at
@@ -210,12 +217,53 @@ class ServingMetrics:
         # request, i.e. the GRU compute the convergence gate recovered.
         self._iters_lock = threading.Lock()
         self._iters_by_tier: Dict[str, Tuple[Histogram, Counter]] = {}
+        # XL tier + tiling instruments (serving/engine.py xl mesh groups,
+        # serving/tiles.py): how much big-image traffic runs sharded, how
+        # much falls back to tiles, and what the tiles' measured seam
+        # disagreement is.  The per-(mesh, bucket) HBM gauge family
+        # surfaces the sharding win itself — per-device bytes from the xl
+        # executable's memory_analysis, directly comparable to the solo
+        # bucket's record in /debug/compiles.
+        self.xl_dispatches = r.counter(
+            "serve_xl_dispatches_total",
+            "device-group dispatches of mesh-sharded xl bucket "
+            "executables")
+        self.tiled_requests = r.counter(
+            "serve_tiled_requests_total",
+            "requests answered by halo-overlap tiling (stitched from "
+            "multiple bucket dispatches)")
+        self.tile_seam_epe = r.histogram(
+            "serve_tile_seam_epe",
+            "mean |delta disparity| (px) between adjacent tiles' "
+            "predictions on their overlap rows — the measured accuracy "
+            "cost of tiling (serving/tiles.py)",
+            buckets=SEAM_EPE_BUCKETS)
+        self._xl_hbm_lock = threading.Lock()
+        self._xl_hbm: Dict[Tuple[str, str], Gauge] = {}
         self.last_batch_unix = r.gauge(
             "serve_last_batch_unix_seconds",
             "wall-clock time the last micro-batch finished (0 until one "
             "does)")
         self._age_lock = threading.Lock()
         self._last_batch_mono: Optional[float] = None
+
+    def xl_hbm_gauge(self, mesh: str, bucket: str) -> Gauge:
+        """``serve_xl_hbm_bytes{mesh=,bucket=}``: per-device HBM of one
+        compiled xl bucket executable (CompileRecord.hbm_bytes — the
+        ROWSGRU_MEMORY scaling claim, measured through the serving path).
+        ``mesh`` is the compact spec label (``"rows4"``); the solo
+        comparison row uses ``mesh="solo"``."""
+        with self._xl_hbm_lock:
+            g = self._xl_hbm.get((mesh, bucket))
+            if g is None:
+                g = self.registry.gauge(
+                    "serve_xl_hbm_bytes",
+                    "per-device HBM bytes of a compiled xl bucket "
+                    "executable (memory_analysis via the compile-cost "
+                    "registry; 0 when the analysis degraded)",
+                    labels={"mesh": mesh, "bucket": bucket})
+                self._xl_hbm[(mesh, bucket)] = g
+        return g
 
     def circuit_gauge(self, device_index: int) -> Gauge:
         """The ``serve_circuit_state{device="N"}`` gauge for one device

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""CI smoke: the XL serving tier end to end on the 8-virtual-device CPU
+backend.
+
+The acceptance path of round 17, wired through REAL HTTP:
+
+1. an oversized request routes to the mesh-sharded xl family — answered
+   with ``X-Tier: xl`` / ``X-Mesh`` headers, its gathered disparity
+   within 5e-4 of the solo runner (one GRU iteration: reassociation
+   noise amplifies ~6x per iteration on random weights), and its
+   ``,mesh=rows4`` executable's per-device HBM strictly below the solo
+   program's for the same bucket (the ROWSGRU_MEMORY scaling claim,
+   measured through the serving path);
+2. a beyond-mesh request is answered by halo-overlap tiling through the
+   ordinary batcher — ``X-Tiles: N`` with a finite stitched map and the
+   measured ``X-Seam-EPE``;
+3. the xl metrics are present in ``/metrics``
+   (serve_xl_dispatches_total, serve_xl_hbm_bytes, serve_tile_seam_epe,
+   serve_tiled_requests_total) and /healthz reports the tier topology.
+
+Writes ``XL_ci.json`` (set XL_CI_OUT; CI uploads it).  Exit 0 on
+success, non-zero with a diagnostic on any failed assertion.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/xl_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+OUT = os.environ.get("XL_CI_OUT", os.path.join(_REPO, "XL_ci.json"))
+
+
+def main() -> int:
+    from _hermetic import force_cpu
+    jax = force_cpu(8)
+
+    import io
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+    from raft_stereo_tpu.telemetry.events import bench_record
+
+    t_start = time.perf_counter()
+    cfg = RaftStereoConfig(n_gru_layers=3, hidden_dims=(48, 48, 48),
+                           fnet_dim=96, corr_levels=2, corr_radius=3,
+                           corr_backend="reg")
+    model = RAFTStereo(cfg)
+    img_s = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img_s, img_s, iters=1,
+                                             test_mode=True)
+                        )(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    XL_HW = (512, 64)        # rows=4-compatible (slab 32 = 2*halo)
+    TILE_HW = (768, 64)      # beyond tile_threshold -> 3 tiles
+    left = rng.integers(0, 255, XL_HW + (3,), dtype=np.uint8)
+    right = np.roll(left, -4, axis=1)
+    tleft = rng.integers(0, 255, TILE_HW + (3,), dtype=np.uint8)
+    tright = np.roll(tleft, -4, axis=1)
+
+    # Solo reference for the parity + HBM comparisons.
+    solo_flow, _ = InferenceRunner(cfg, variables, iters=1)(left, right)
+
+    # Routing bands for the smoke's two request sizes: 512x64 = 32768 px
+    # sits in the xl band (threshold 20k < px <= cap 40k); 768x64 =
+    # 49152 px is beyond the mesh cap AND past the tile threshold ->
+    # halo-tiled through the ordinary batcher.
+    svc = StereoService(cfg, variables, ServeConfig(
+        iters=1, cost_telemetry=True,
+        xl_mesh="rows=4", xl_threshold_pixels=20_000,
+        xl_max_pixels=40_000,
+        tile_threshold_pixels=40_000, tile_rows=256, tile_halo=32))
+    assert svc.xl_enabled, "8 virtual devices must supply a rows=4 mesh"
+    server = StereoHTTPServer(svc, port=0).start()
+    url = server.url
+
+    def post(l, r, path="/v1/disparity"):
+        buf = io.BytesIO()
+        np.savez(buf, left=l, right=r)
+        req = urllib.request.Request(
+            url + path, data=buf.getvalue(), method="POST",
+            headers={"Content-Type": "application/x-npz"})
+        resp = urllib.request.urlopen(req, timeout=1200)
+        disp = np.load(io.BytesIO(resp.read()))
+        return resp.headers, disp
+
+    try:
+        # --- 1. oversized request -> xl mesh dispatch over HTTP -------
+        hdr, disp = post(left, right)
+        assert hdr.get("X-Tier") == "xl", \
+            f"expected X-Tier: xl, got {hdr.get('X-Tier')!r}"
+        assert hdr.get("X-Mesh") == "rows4", hdr.get("X-Mesh")
+        xl_err = float(np.abs(-disp - solo_flow).max())
+        assert xl_err < 5e-4, \
+            f"xl-vs-solo disparity max|diff| {xl_err:.2e} >= 5e-4"
+
+        rec_xl = svc.compiled_cost(XL_HW, 1, family="xl")
+        assert rec_xl is not None and ",mesh=rows4" in rec_xl.key
+        # Solo record for the SAME bucket (compiled out of band — the
+        # server never solo-dispatches this oversized bucket).
+        with StereoService(cfg, variables, ServeConfig(
+                iters=1, cost_telemetry=True)) as solo_svc:
+            solo_svc.infer(left, right, timeout=1200)
+            rec_solo = solo_svc.compiled_cost(XL_HW, 1)
+        hbm_ratio = None
+        if (rec_xl.hbm_bytes and rec_solo is not None
+                and rec_solo.hbm_bytes):
+            hbm_ratio = rec_xl.hbm_bytes / rec_solo.hbm_bytes
+            assert rec_xl.hbm_bytes < rec_solo.hbm_bytes, (
+                f"xl per-device HBM {rec_xl.hbm_bytes} must sit below "
+                f"solo {rec_solo.hbm_bytes}")
+
+        # --- 2. beyond-mesh request -> halo-tiled dispatches ----------
+        # 768x64 = 49k px: above tile_threshold, below xl_threshold.
+        thdr, tdisp = post(tleft, tright)
+        tiles = int(thdr.get("X-Tiles", "0"))
+        assert tiles >= 2, f"expected a tiled answer, X-Tiles={tiles}"
+        assert tdisp.shape == TILE_HW and np.isfinite(tdisp).all()
+        seam = thdr.get("X-Seam-EPE")
+        assert seam is not None and float(seam) >= 0.0
+
+        # --- 3. xl metrics + health surface ---------------------------
+        metrics = urllib.request.urlopen(url + "/metrics",
+                                         timeout=60).read().decode()
+        for needle in ("serve_xl_dispatches_total 1",
+                       "serve_xl_hbm_bytes",
+                       "serve_tiled_requests_total 1",
+                       "serve_tile_seam_epe_count 1"):
+            assert needle in metrics, f"{needle!r} missing from /metrics"
+        health = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=60).read())
+        assert health["xl"] and health["xl"]["label"] == "rows4"
+
+        rec = bench_record({
+            "metric": "xl_smoke",
+            "xl_bucket": f"{XL_HW[0]}x{XL_HW[1]}",
+            "mesh": "rows=4",
+            "xl_vs_solo_max_abs_px": round(xl_err, 8),
+            "xl_per_device_hbm_mib": (
+                round(rec_xl.hbm_bytes / 2**20, 1)
+                if rec_xl.hbm_bytes else None),
+            "solo_hbm_mib": (
+                round(rec_solo.hbm_bytes / 2**20, 1)
+                if rec_solo is not None and rec_solo.hbm_bytes else None),
+            "xl_hbm_ratio": (round(hbm_ratio, 3)
+                             if hbm_ratio is not None else None),
+            "tiled_bucket": f"{TILE_HW[0]}x{TILE_HW[1]}",
+            "tiles": tiles,
+            "seam_epe_px": float(seam),
+            "wall_s": round(time.perf_counter() - t_start, 1),
+        })
+        with open(OUT, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(json.dumps(rec))
+        print("XL SMOKE OK")
+        return 0
+    finally:
+        server.shutdown()
+        svc.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ def _pair(rng, h, w, b=1):
     return img1, img2
 
 
+@pytest.mark.slow
 def test_rows_gru_test_mode_matches_plain(rng):
     cfg = _small_cfg()
     cfg_r = dataclasses.replace(cfg, rows_shards=2, rows_gru=True,
@@ -61,6 +62,7 @@ def test_rows_gru_test_mode_matches_plain(rng):
                                rtol=1e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_rows_gru_train_mode_matches_plain(rng):
     """Per-iteration full-resolution predictions equal the plain scan's —
     including through the remat(save_only corr_lookup) policy, which the
@@ -92,6 +94,7 @@ def test_rows_gru_config_validation():
         RaftStereoConfig(rows_gru=True, rows_shards=2, rows_gru_halo=10)
 
 
+@pytest.mark.slow
 def test_rows_gru_geometry_validation(rng):
     """A slab shorter than 2*halo cannot be sourced by one ppermute — the
     trace fails with the fix-it message instead of silently losing rows."""

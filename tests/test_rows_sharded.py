@@ -20,6 +20,7 @@ def _mesh(n):
 
 @pytest.mark.parametrize("norm_fn", ["instance", "batch", "none"])
 @pytest.mark.parametrize("n_shards", [2, 4])
+@pytest.mark.slow
 def test_rows_sharded_matches_trunk(rng, norm_fn, n_shards):
     trunk = _Trunk(norm_fn, downsample=2, dtype=jnp.float32)
     h, w = 16 * n_shards, 32
@@ -35,6 +36,7 @@ def test_rows_sharded_matches_trunk(rng, norm_fn, n_shards):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_rows_sharded_feeds_encoder(rng):
     """The sharded trunk output slots into BasicEncoder's trunk_out hook
     (the same injection point the banded executor uses), producing the

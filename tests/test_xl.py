@@ -1,0 +1,285 @@
+"""XL serving tier (round 17): mesh-sharded bucket executables, the
+halo-overlap tiling fallback, and the device-group plumbing.
+
+The headline pins are the acceptance criteria: a rows-mesh xl bucket
+executable produces a gathered disparity matching the single-device
+program (5e-4 at one GRU iteration — reassociation noise amplifies ~6x
+per iteration through the correlation lookup on random weights, so
+deeper pins would measure the weights' conditioning, not the sharding;
+rows=1 is bitwise the solo program by construction), and tiling's
+stitching math is exact on consistent fields (zero seam) while the seam
+metric is live on inconsistent ones.  The full-model rows>=4 parity and
+prewarm/readiness pins ride the slow tier (full mesh traces are ~tens
+of seconds each on the CPU backend); scripts/xl_smoke.py runs the same
+acceptance path in CI over real HTTP.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.eval.runner import InferenceRunner
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+from raft_stereo_tpu.parallel.distributed import device_groups
+from raft_stereo_tpu.parallel.mesh import mesh_spec_label, parse_mesh_spec
+from raft_stereo_tpu.serving import (ServeConfig, ServingEngine, plan_tiles,
+                                     seam_epe, stitch)
+from raft_stereo_tpu.serving.persist import executable_cache_key
+
+
+def _small_cfg(**kw):
+    """The rows_gru test architecture (tests/test_rows_gru.py): 3 GRU
+    levels, small dims, pure-XLA 'reg' corr."""
+    base = dict(n_gru_layers=3, hidden_dims=(48, 48, 48), fnet_dim=96,
+                corr_levels=2, corr_radius=3, corr_backend="reg")
+    base.update(kw)
+    return RaftStereoConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    """ONE init shared by every engine test in this module — the
+    parameter tree is architecture-determined, so configs that differ
+    only in execution knobs (halo, mesh, thresholds) all consume it."""
+    cfg = _small_cfg()
+    model = RAFTStereo(cfg)
+    img = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = jax.jit(lambda r: model.init(r, img, img, iters=1,
+                                             test_mode=True)
+                        )(jax.random.PRNGKey(0))
+    return cfg, variables
+
+
+def _pair(rng, h, w):
+    left = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    return left, np.roll(left, -4, axis=1)
+
+
+# ------------------------------------------------------------ mesh specs
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("rows=4") == {"rows": 4, "corr": 1}
+    assert parse_mesh_spec("rows=2,corr=2") == {"rows": 2, "corr": 2}
+    assert parse_mesh_spec(" corr=2 ") == {"rows": 1, "corr": 2}
+    for bad in ("", "rows", "rows=0", "rows=x", "data=2", "rows=2,rows=2"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_mesh_spec_label():
+    assert mesh_spec_label({"rows": 4, "corr": 1}) == "rows4"
+    assert mesh_spec_label({"rows": 2, "corr": 2}) == "rows2corr2"
+    assert mesh_spec_label({"rows": 1, "corr": 1}) == "solo"
+
+
+# --------------------------------------------------------- device groups
+def test_device_groups_partitions_disjoint():
+    devs = jax.devices()
+    groups = device_groups(2, devices=devs)
+    assert len(groups) == len(devs) // 2
+    flat = [d for g in groups for d in g]
+    assert len(set(id(d) for d in flat)) == len(flat)   # disjoint
+    # Stable id order: group 0 holds the lowest ids.
+    assert [d.id for d in groups[0]] == sorted(d.id for d in devs)[:2]
+
+
+def test_device_groups_skip_and_shortfall():
+    devs = jax.devices()
+    # skip=1 leaves device 0 (a solo worker) unassigned.
+    groups = device_groups(4, n_groups=1, devices=devs, skip=1)
+    assert len(groups) == 1
+    assert devs[0].id not in [d.id for d in groups[0]]
+    # Asking for more than fits is a typed EMPTY result, not an error.
+    assert device_groups(len(devs) + 1, devices=devs) == []
+    assert device_groups(4, n_groups=3, devices=devs) == []
+    with pytest.raises(ValueError):
+        device_groups(0)
+
+
+# ----------------------------------------------------------------- tiles
+def test_plan_tiles_geometry():
+    specs = plan_tiles(512, tile_rows=128, halo=32)
+    assert len(specs) == 4
+    # Equal extents (one bucket => tiles batch together) and an exact
+    # partition of the owned rows.
+    assert len({s.height for s in specs}) == 1
+    assert specs[0].height == 128 + 2 * 32
+    assert specs[0].y0 == 0 and specs[-1].y1 == 512
+    for a, b in zip(specs, specs[1:]):
+        assert a.y1 == b.y0
+    # Window extents stay inside the image (edge tiles shift inward).
+    assert all(0 <= s.src0 and s.src1 <= 512 for s in specs)
+
+
+def test_plan_tiles_single_when_short():
+    specs = plan_tiles(100, tile_rows=128, halo=32)
+    assert len(specs) == 1 and specs[0].src0 == 0 and specs[0].src1 == 100
+
+
+def test_tiles_stitch_consistent_field_zero_seam(rng):
+    """Tiles that are restrictions of ONE global field stitch back to it
+    exactly, with zero seam error — the uniform-disparity property."""
+    field = rng.uniform(-64, 0, (512, 96)).astype(np.float32)
+    specs = plan_tiles(512, tile_rows=128, halo=32)
+    flows = [field[s.src0:s.src1] for s in specs]
+    out = stitch(flows, specs)
+    np.testing.assert_array_equal(out, field)
+    assert seam_epe(flows, specs) == 0.0
+
+
+def test_tiles_seam_metric_fires_on_disagreement(rng):
+    """Per-tile perturbations (what real tiling produces on textured
+    content: each tile saw different vertical context) register in the
+    seam metric."""
+    field = rng.uniform(-64, 0, (512, 96)).astype(np.float32)
+    specs = plan_tiles(512, tile_rows=128, halo=32)
+    flows = [field[s.src0:s.src1] + 0.1 * i for i, s in enumerate(specs)]
+    assert seam_epe(flows, specs) > 0.01
+    # Single tile: nothing overlaps, the metric is typed absent.
+    one = plan_tiles(100, tile_rows=128, halo=32)
+    assert seam_epe([field[:100]], one) is None
+
+
+# --------------------------------------------------------- persist keys
+def test_xl_persist_keys_distinct():
+    base = dict(config="{}", bucket=(512, 640), batch=1, tier=None,
+                iters=32, fetch_dtype=None, donate=True, family=None,
+                flow_init=False, quant="off", device="0")
+    solo = executable_cache_key(**base)
+    xl = executable_cache_key(**{**base, "family": "xl",
+                                 "mesh": "rows4", "device": "0+1+2+3"})
+    xl2 = executable_cache_key(**{**base, "family": "xl",
+                                  "mesh": "rows2corr2",
+                                  "device": "0+1+2+3"})
+    assert len({solo, xl, xl2}) == 3
+
+
+# ------------------------------------------------------- engine routing
+def test_engine_without_xl_rejects_xl_tier(small_model, rng):
+    cfg, v = small_model
+    with ServingEngine(cfg, v, ServeConfig(iters=1)) as eng:
+        assert not eng.xl_enabled
+        assert eng.xl_status() is None
+        left, right = _pair(rng, 64, 64)
+        with pytest.raises(ValueError, match="no xl tier"):
+            eng.submit(left, right, tier="xl")
+
+
+def test_engine_xl_skips_typed_when_devices_short(small_model):
+    """A replica whose devices cannot supply the mesh serves WITHOUT
+    the tier (typed skip), instead of crashing at boot — the
+    compile-farm / heterogeneous-fleet contract."""
+    cfg, v = small_model
+    with ServingEngine(cfg, v, ServeConfig(
+            iters=1, xl_mesh=f"rows={2 * len(jax.devices())}")) as eng:
+        assert not eng.xl_enabled
+        # Big buckets quietly fall back to the solo/tiling routing.
+        assert not eng._xl_routes((512, 64))
+
+
+def test_engine_xl_incompatible_bucket_is_typed(small_model, rng):
+    """A bucket that violates the mesh geometry (too few rows per
+    shard) never auto-routes to xl, and forcing ?tier=xl on it is a
+    typed client error."""
+    cfg, v = small_model
+    with ServingEngine(cfg, v, ServeConfig(
+            iters=1, xl_mesh="rows=4", xl_threshold_pixels=100)) as eng:
+        assert eng.xl_enabled
+        ok, reason = eng._xl_compatible((64, 96))  # h_f=16: slab < 2*halo
+        assert not ok and reason
+        assert not eng._xl_routes((64, 96))
+        left, right = _pair(rng, 64, 96)
+        with pytest.raises(ValueError, match="does not fit mesh"):
+            eng.submit(left, right, tier="xl")
+
+
+def test_engine_xl_rows1_bitwise_and_tiling(small_model, rng):
+    """One engine serving BOTH round-17 paths:
+
+    * the degenerate rows=1 mesh — the xl family compiles the IDENTICAL
+      solo program (make_forward_mesh falls back to make_forward), so
+      the gathered output is bitwise the solo runner's;
+    * a bucket past the mesh cap (xl_max_pixels) falls through to
+      halo-overlap tiling: N equal tiles through ordinary bucket
+      dispatches, one stitched full-res answer, seam metric observed —
+      no new scheduler."""
+    cfg, v = small_model
+    left, right = _pair(rng, 64, 96)
+    solo_flow, _ = InferenceRunner(cfg, v, iters=2)(left, right)
+    with ServingEngine(cfg, v, ServeConfig(
+            iters=2, xl_mesh="rows=1", xl_threshold_pixels=1000,
+            xl_max_pixels=7000,
+            tile_threshold_pixels=8000, tile_rows=64,
+            tile_halo=16)) as eng:
+        assert eng.xl_enabled
+        # 64x96 = 6144 px: inside the xl band -> one mesh dispatch.
+        res = eng.infer(left, right, timeout=300)
+        assert res.tier == "xl" and res.mesh == "solo"
+        np.testing.assert_array_equal(res.flow, solo_flow)
+        assert eng.metrics.xl_dispatches.value == 1
+        # 192x64 = 12288 px: past the mesh cap AND the tile threshold
+        # -> 3 halo-overlap tiles (extent 96 rows each), stitched.
+        tleft, tright = _pair(rng, 192, 64)
+        tres = eng.infer(tleft, tright, timeout=600)
+        assert tres.tiles == 3 and tres.tier is None
+        assert tres.flow.shape == (192, 64)
+        assert np.isfinite(tres.flow).all()
+        assert tres.seam_epe is not None and tres.seam_epe >= 0.0
+        assert eng.metrics.tiled_requests.value == 1
+        assert eng.metrics.tile_seam_epe.count == 1
+        # The three tiles ran as ordinary completed bucket requests.
+        assert eng.metrics.completed.value == 1 + 3
+
+
+@pytest.mark.slow
+def test_engine_xl_rows4_parity_5e4(small_model, rng):
+    """The acceptance pin: an xl bucket executable sharded over a
+    rows=4 mesh on the 8-virtual-device CPU backend produces a gathered
+    disparity within 5e-4 of the single-device program, with a distinct
+    ',mesh=rows4' cost record whose per-device HBM sits strictly below
+    the solo record's."""
+    cfg, v = small_model
+    H, W = 512, 64
+    left, right = _pair(rng, H, W)
+    solo_flow, _ = InferenceRunner(cfg, v, iters=1)(left, right)
+    with ServingEngine(cfg, v, ServeConfig(
+            iters=1, xl_mesh="rows=4", xl_threshold_pixels=10_000,
+            cost_telemetry=True)) as eng:
+        assert eng.xl_enabled
+        res = eng.infer(left, right, timeout=600)
+        assert res.tier == "xl" and res.mesh == "rows4"
+        assert float(np.abs(res.flow - solo_flow).max()) < 5e-4
+        rec = eng.compiled_cost((H, W), 1, family="xl")
+        assert rec is not None and ",mesh=rows4" in rec.key
+        xl_hbm = rec.hbm_bytes
+    with ServingEngine(cfg, v, ServeConfig(
+            iters=1, cost_telemetry=True)) as solo_eng:
+        solo_eng.infer(left, right, timeout=600)
+        solo_rec = solo_eng.compiled_cost((H, W), 1)
+    if xl_hbm and solo_rec is not None and solo_rec.hbm_bytes:
+        assert xl_hbm < solo_rec.hbm_bytes
+
+
+@pytest.mark.slow
+def test_xl_warm_target_and_readiness(small_model, rng):
+    """An xl-routed warmup shape puts the XL ladder (not the solo
+    ladder) on the readiness surface, and prewarm opens the gate."""
+    cfg, v = small_model
+    import dataclasses
+    cfg = dataclasses.replace(cfg, rows_gru_halo=8)
+    H, W = 128, 64     # h_f=32, slab 16 = 2*halo -> mesh-compatible
+    serve_cfg = ServeConfig(
+        iters=1, xl_mesh="rows=2", xl_threshold_pixels=4000,
+        warmup_shapes=((H, W),), prewarm_on_init=False)
+    with ServingEngine(cfg, v, serve_cfg) as eng:
+        assert eng.xl_enabled
+        assert not eng.ready
+        with eng._warm_lock:
+            target = set(eng._warm_target)
+        assert all(entry[4] == "xl" for entry in target)
+        eng.prewarm((H, W))
+        assert eng.ready
+        # Traffic at the warmed bucket dispatches xl without compiling.
+        res = eng.infer(*_pair(rng, H, W), timeout=300)
+        assert res.tier == "xl"

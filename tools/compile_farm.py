@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also build the session state/warm families "
                         "(replicas running --sessions need them)")
     p.add_argument("--session_ctx_cache", action="store_true")
+    p.add_argument("--xl_mesh", default=None,
+                   help="also build the xl (mesh-sharded) ladder for "
+                        "shapes past --xl_threshold_pixels, exactly as "
+                        "replicas running --xl_mesh serve it.  A farm "
+                        "host with fewer devices than the mesh skips "
+                        "the xl ladder with a typed log line instead of "
+                        "failing the whole build")
+    p.add_argument("--xl_workers", type=int, default=1)
+    p.add_argument("--xl_threshold_pixels", type=int, default=2_000_000)
+    p.add_argument("--xl_batch_sizes", default="1")
     p.add_argument("--quant_scales", default=None)
     p.add_argument("--max_bytes", type=int, default=None,
                    help="GC bound applied to the store after the build")
@@ -113,6 +123,11 @@ def run(args) -> int:
         fetch_dtype=args.fetch_dtype,
         sessions=args.sessions,
         session_ctx_cache=args.session_ctx_cache,
+        xl_mesh=args.xl_mesh,
+        xl_workers=args.xl_workers,
+        xl_threshold_pixels=args.xl_threshold_pixels,
+        xl_batch_sizes=tuple(int(s)
+                             for s in args.xl_batch_sizes.split(",")),
         quant_scales_path=args.quant_scales,
         executable_cache_dir=args.out,
         executable_cache_max_bytes=args.max_bytes,
@@ -138,6 +153,8 @@ def run(args) -> int:
             "batch_sizes": sorted(svc.queue.sizes),
             "tiers": list(tiers),
             "families": [f or "base" for f in svc._families()],
+            "xl": svc.xl_status(),
+            "xl_requested": args.xl_mesh,
             "sessions": bool(args.sessions),
             "iters": args.valid_iters,
             "artifacts_built": built,
