@@ -20,8 +20,30 @@ Stateless requests balance by measured queue depth; streaming sessions
 consistent-hash to one replica (sticky warm-start state); a dead replica
 is failed over in one health-poll interval — stateless traffic reroutes
 transparently, its sessions fail typed (410 ``session_lost``) and
-reseed cold on survivors.  See docs/architecture.md §Fleet and the
-README runbook "a replica died".
+reseed cold on survivors.  A GRACEFULLY draining replica (SIGTERM /
+rolling restart) instead hands its sessions off through the artifact
+store — zero 410s, warm first frames on the survivors.
+
+High availability (round 18): run TWO routers over one shared ledger
+directory (inside the artifact store) — the standby serves traffic the
+whole time and takes over the replicated lost-session/handoff ledger
+when the primary dies::
+
+    raft-route --port 8550 --ha_dir /shared/store/fleet --name rt-a ...
+    raft-route --port 8560 --ha_dir /shared/store/fleet --name rt-b \\
+        --standby --peer http://127.0.0.1:8550 ...
+
+Autoscaling: give the router a replica launch template and bounds, and
+it scales the fleet on the aggregate pressure signal (scale-down always
+drains — never kills)::
+
+    raft-route ... --autoscale_cmd \\
+        "python -m raft_stereo_tpu.cli.serve --restore_ckpt ckpt \\
+         --port {port} --executable_cache_dir /shared/store --sessions" \\
+        --autoscale_max 6
+
+See docs/architecture.md §Fleet and the README runbooks "a replica
+died", "roll a replica without dropping streams", "the router died".
 """
 
 from __future__ import annotations
@@ -54,14 +76,46 @@ def build_router(args):
         fleet_brownout=args.fleet_brownout,
         brownout_engage_fraction=args.brownout_engage_fraction,
         brownout_restore_fraction=args.brownout_restore_fraction,
-        brownout_max_level=args.brownout_max_level)
+        brownout_max_level=args.brownout_max_level,
+        session_lost_cap=args.session_lost_cap,
+        ha_dir=args.ha_dir,
+        router_name=args.name,
+        standby=args.standby,
+        lease_ttl_s=args.lease_ttl_s,
+        peer_url=args.peer)
     return FleetRouter(replicas, cfg)
+
+
+def build_autoscaler(args, router):
+    """Optional pressure-driven autoscaler over a local-subprocess
+    launcher (the k8s seam is the ReplicaLauncher interface)."""
+    if not args.autoscale_cmd:
+        return None
+    from raft_stereo_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                               LocalProcessLauncher,
+                                               serve_argv_template)
+
+    launcher = LocalProcessLauncher(
+        serve_argv_template(args.autoscale_cmd),
+        log_dir=args.autoscale_log_dir)
+    cfg = AutoscaleConfig(
+        min_replicas=args.autoscale_min,
+        max_replicas=args.autoscale_max,
+        engage_fraction=args.autoscale_engage_fraction,
+        engage_s=args.autoscale_engage_s,
+        restore_fraction=args.autoscale_restore_fraction,
+        restore_s=args.autoscale_restore_s,
+        cooldown_s=args.autoscale_cooldown_s)
+    return Autoscaler(router, launcher, cfg)
 
 
 def run_route(args) -> int:
     from raft_stereo_tpu.serving.fleet import RouterHTTPServer
 
     router = build_router(args).start()
+    autoscaler = build_autoscaler(args, router)
+    if autoscaler is not None:
+        autoscaler.start()
     server = RouterHTTPServer(router, host=args.host, port=args.port)
     stop = threading.Event()
 
@@ -76,15 +130,18 @@ def run_route(args) -> int:
             signal.signal(sig, _graceful)
 
     status = router.fleet_status()
-    log.info("routing on %s over %d replica(s), %d ready: %s",
+    log.info("routing on %s over %d replica(s), %d ready, role %s: %s",
              f"http://{args.host}:{args.port}", status["total"],
-             status["ready"],
+             status["ready"], status["role"],
              {n: r["url"] for n, r in status["replicas"].items()})
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+            autoscaler.launcher.stop_all()
         router.stop()
         if not stop.is_set():
             server.shutdown()
@@ -127,6 +184,56 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--brownout_restore_fraction", type=float,
                    default=0.25)
     p.add_argument("--brownout_max_level", type=int, default=2)
+    p.add_argument("--session_lost_cap", type=int, default=4096,
+                   help="capacity cap on the lost-session/handoff "
+                        "ledgers (oldest owed 410s are forgotten past "
+                        "this; fleet_lost_ledger_size tracks the size)")
+    # HA pair (docs/architecture.md §Fleet, "Router HA").
+    p.add_argument("--name", default="router",
+                   help="this router's name in the shared lease/ledger")
+    p.add_argument("--ha_dir", default=None,
+                   help="shared lease + ledger directory for an HA "
+                        "router pair (put it inside the artifact "
+                        "store, e.g. /shared/store/fleet).  Unset: "
+                        "single-router mode")
+    p.add_argument("--standby", action="store_true",
+                   help="start PASSIVE: serve traffic but hold no "
+                        "lease; take over (bump the fencing epoch, "
+                        "replay the ledger) when the primary's lease "
+                        "goes stale or --peer stops answering")
+    p.add_argument("--peer", default=None,
+                   help="the primary router's URL (standby only): "
+                        "probing it detects a kill -9 faster than "
+                        "lease staleness alone")
+    p.add_argument("--lease_ttl_s", type=float, default=3.0,
+                   help="lease staleness window: the standby takes "
+                        "over once the primary has not renewed for "
+                        "this long")
+    # Autoscaling (fleet/autoscaler.py).
+    p.add_argument("--autoscale_cmd", default=None,
+                   help="enable pressure-driven autoscaling: a "
+                        "raft-serve command template with a {port} "
+                        "placeholder (and optional {name}), e.g. "
+                        "\"python -m raft_stereo_tpu.cli.serve "
+                        "--restore_ckpt ckpt --port {port} "
+                        "--executable_cache_dir /shared/store "
+                        "--sessions\".  Scale-down always drains "
+                        "(session handoff), never kills")
+    p.add_argument("--autoscale_min", type=int, default=1)
+    p.add_argument("--autoscale_max", type=int, default=4)
+    p.add_argument("--autoscale_engage_fraction", type=float,
+                   default=0.6,
+                   help="composite pressure (max of queued fraction, "
+                        "normalized brownout level, deadline-miss "
+                        "rate) that must sustain --autoscale_engage_s "
+                        "to scale up")
+    p.add_argument("--autoscale_engage_s", type=float, default=2.0)
+    p.add_argument("--autoscale_restore_fraction", type=float,
+                   default=0.15)
+    p.add_argument("--autoscale_restore_s", type=float, default=10.0)
+    p.add_argument("--autoscale_cooldown_s", type=float, default=5.0)
+    p.add_argument("--autoscale_log_dir", default=None,
+                   help="directory for launched replicas' logs")
     return p
 
 

@@ -183,6 +183,18 @@ def run_serve(args) -> int:
         # listener first, which dropped exactly the work drain() was
         # about to finish).
         service.begin_shutdown()
+        # Phase 1b (round 18): hand the live streams off.  The export
+        # waits on each session's ordering lock (in-flight frames fold
+        # their state in first — bounded, since admission just
+        # stopped), publishes the blob into the shared artifact store,
+        # and /admin/handoff starts answering the manifest the router
+        # polls for.  On a thread: the signal handler must return so
+        # the drain below can make progress.
+        if (service.sessions is not None
+                and service.handoff_store is not None):
+            threading.Thread(target=service.publish_handoff,
+                             daemon=True,
+                             name="session-handoff").start()
         stop.set()
 
     if threading.current_thread() is threading.main_thread():
@@ -225,6 +237,31 @@ def run_serve(args) -> int:
             # (engine.drain waits on all three), then stop.  /readyz has
             # been 503 since phase 1, so no router is still sending here.
             drained = service.drain(timeout=args.drain_timeout_s)
+            # Phase 2b: with a handoff published, keep the listener up
+            # until a router actually FETCHED the manifest (bounded by
+            # --handoff_linger_s).  An instant drain would otherwise
+            # close the port inside the router's health-poll window and
+            # the planned restart would read as a crash — exactly the
+            # typed 410s the handoff exists to prevent.
+            if (service.sessions is not None
+                    and service.handoff_store is not None
+                    and args.handoff_linger_s > 0):
+                # The publish thread may still be folding in the last
+                # in-flight frames (it waits on their ordering locks,
+                # which released as the drain finished) — wait for the
+                # manifest first, then for a router to fetch it.
+                t_end = time.monotonic() + args.handoff_linger_s
+                while (service.handoff_manifest is None
+                       and time.monotonic() < t_end):
+                    time.sleep(0.05)
+                manifest = service.handoff_manifest
+                if manifest is not None and manifest.get("count", 0):
+                    fetched = service.wait_handoff_fetched(
+                        args.handoff_linger_s)
+                    log.info("handoff manifest %s by a router "
+                             "(lingered <= %.1fs)",
+                             "fetched" if fetched else "NEVER fetched",
+                             args.handoff_linger_s)
             log.info("drain %s; final metrics:\n%s",
                      "complete" if drained else
                      f"timed out after {args.drain_timeout_s:.0f}s",
@@ -304,6 +341,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "X-Deadline-Ms header overrides)")
     p.add_argument("--drain_timeout_s", type=float, default=30.0,
                    help="max seconds to finish queued work on SIGTERM")
+    p.add_argument("--handoff_linger_s", type=float, default=5.0,
+                   help="after a graceful drain published a session "
+                        "handoff, keep the listener up to this many "
+                        "seconds for a router to fetch /admin/handoff "
+                        "(an instant drain must not close the port "
+                        "before the router's next health poll); 0 "
+                        "disables the linger")
     p.add_argument("--fetch_dtype", default=None,
                    choices=["fp16", "bf16"],
                    help="half-precision device->host disparity fetch "

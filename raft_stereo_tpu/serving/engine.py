@@ -123,7 +123,9 @@ from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
                                                 circuit_state_name,
                                                 cost_ladder)
 from raft_stereo_tpu.serving.sessions import (SessionsDisabled, SessionStore,
-                                              frame_delta, frame_thumbnail)
+                                              frame_delta, frame_thumbnail,
+                                              handoff_session_ids,
+                                              parse_handoff_blob)
 
 log = logging.getLogger(__name__)
 
@@ -929,6 +931,22 @@ class ServingEngine:
                 created_counter=self.metrics.sessions_created,
                 expired_counter=self.metrics.sessions_expired,
                 evicted_counter=self.metrics.sessions_evicted)
+        # Session handoff (round 18): the artifact store's sessions/
+        # namespace a draining engine publishes its live streams into,
+        # and a receiving engine lazily adopts them from
+        # (submit_session handoff_key=).  Needs BOTH the session store
+        # and a shared artifact directory; absent either, drains keep
+        # the r16 typed-loss behavior.
+        self.handoff_store = None
+        self._handoff_manifest: Optional[Dict[str, object]] = None
+        self._handoff_fetched = threading.Event()
+        self._handoff_lock = threading.Lock()
+        self._handoff_blobs: Dict[str, Dict] = {}
+        if serve_cfg.sessions and serve_cfg.executable_cache_dir:
+            from raft_stereo_tpu.serving.persist import SessionHandoffStore
+            self.handoff_store = SessionHandoffStore(
+                serve_cfg.executable_cache_dir,
+                ttl_s=max(serve_cfg.session_ttl_s, 60.0) * 4)
         # Retry bookkeeping: requests bounced by a crashed dispatch sit in
         # backoff timers between dequeue and requeue — drain() must wait
         # for them and close() must fail them, so they are accounted here.
@@ -1463,7 +1481,8 @@ class ServingEngine:
                        right: np.ndarray,
                        deadline_ms: Optional[float] = None,
                        tier: Optional[str] = None,
-                       degradable: bool = True) -> Future:
+                       degradable: bool = True,
+                       handoff_key: Optional[str] = None) -> Future:
         """Admit one frame of a streaming session (the engine behind
         ``POST /v1/stream/<session>``).  Returns a Future of
         ``ServeResult`` whose session fields say what happened:
@@ -1502,6 +1521,16 @@ class ServingEngine:
         # frame's future resolved (its done-callback releases the lock).
         sess.order_lock.acquire()
         try:
+            if created and handoff_key is not None:
+                # Lazy handoff adoption (round 18): the router tagged
+                # this id's first frame here with the draining replica's
+                # published blob — import THAT session's state so this
+                # frame warm-starts exactly where the old replica left
+                # off.  Any failure (missing blob, corrupt entry) just
+                # leaves ``created`` true: the frame cold-starts, which
+                # is the pre-handoff baseline.
+                created = not self._adopt_handoff(sess, session_id,
+                                                  handoff_key)
             thumb = frame_thumbnail(left)
             hp, wp, _grid = self.policy.bucket_for(left.shape[0],
                                                    left.shape[1])
@@ -1561,11 +1590,102 @@ class ServingEngine:
                       deadline_ms: Optional[float] = None,
                       timeout: Optional[float] = None,
                       tier: Optional[str] = None,
-                      degradable: bool = True) -> ServeResult:
+                      degradable: bool = True,
+                      handoff_key: Optional[str] = None) -> ServeResult:
         """Blocking convenience: submit_session + wait."""
         return self.submit_session(
             session_id, left, right, deadline_ms, tier=tier,
-            degradable=degradable).result(timeout=timeout)
+            degradable=degradable,
+            handoff_key=handoff_key).result(timeout=timeout)
+
+    # ------------------------------------------------------ session handoff
+    def _handoff_records(self, key: str) -> Dict:
+        """Parsed ``{sid: (meta, arrays)}`` of one published handoff
+        blob, fetched and decoded at most once per key (N inherited
+        sessions share one artifact read)."""
+        with self._handoff_lock:
+            cached = self._handoff_blobs.get(key)
+        if cached is not None:
+            return cached
+        records: Dict = {}
+        if self.handoff_store is not None:
+            blob = self.handoff_store.fetch(key)
+            if blob is not None:
+                records, skipped = parse_handoff_blob(blob)
+                if skipped:
+                    self.metrics.handoff_import_skipped.inc(skipped)
+            else:
+                log.warning("handoff artifact %s not in the store; its "
+                            "sessions cold-start", key)
+        with self._handoff_lock:
+            self._handoff_blobs[key] = records
+            # A replica inherits from at most a handful of concurrent
+            # drains; keep the parse cache from growing across weeks of
+            # rolling restarts.
+            while len(self._handoff_blobs) > 8:
+                self._handoff_blobs.pop(next(iter(self._handoff_blobs)))
+        return records
+
+    def _adopt_handoff(self, sess, sid: str, key: str) -> bool:
+        """Install the handed-off state for ``sid`` from blob ``key``
+        into the freshly created session; True when adopted (the frame
+        may warm-start)."""
+        rec = self._handoff_records(key).get(sid)
+        if rec is None:
+            return False
+        meta, arrays = rec
+        self.sessions.adopt(sess, meta, arrays)
+        self.metrics.sessions_adopted.inc()
+        log.info("session %s adopted from handoff %s at frame %s "
+                 "(imported warm-start state)", sid, key[:12],
+                 sess.frame_index)
+        return True
+
+    def publish_handoff(self) -> Optional[Dict[str, object]]:
+        """Serialize every live session into the artifact store's
+        ``sessions/`` namespace and remember the manifest ``GET
+        /admin/handoff`` serves (cli/serve.py calls this at SIGTERM,
+        after ``begin_shutdown``).  Returns the manifest — with
+        ``artifact=None`` when there was nothing to export (an empty
+        manifest is still an ANSWER: the router learns definitively
+        that no sessions need remapping).  None only when this engine
+        cannot hand off at all (no session store, or no shared artifact
+        directory) — the router then falls back to the r16 typed-loss
+        path when the process exits."""
+        if self.sessions is None or self.handoff_store is None:
+            return None
+        blob = self.sessions.export()
+        sids = handoff_session_ids(blob)
+        key = None
+        if sids:
+            key = self.handoff_store.publish(blob)
+            if key is None:
+                log.warning("session handoff publish failed; %d "
+                            "session(s) will fail typed on exit instead",
+                            len(sids))
+                sids = []
+            else:
+                self.metrics.sessions_exported.inc(len(sids))
+        manifest = {"artifact": key, "sessions": sids,
+                    "count": len(sids), "published_unix": time.time()}
+        self._handoff_manifest = manifest
+        log.info("session handoff published: %d session(s) -> %s",
+                 len(sids), key and key[:12])
+        return manifest
+
+    @property
+    def handoff_manifest(self) -> Optional[Dict[str, object]]:
+        """The drain handoff manifest (None until ``publish_handoff``
+        ran) — what ``GET /admin/handoff`` serves."""
+        return self._handoff_manifest
+
+    def note_handoff_fetched(self) -> None:
+        """The HTTP layer records that a router fetched the manifest —
+        the CLI's post-drain linger can stop waiting."""
+        self._handoff_fetched.set()
+
+    def wait_handoff_fetched(self, timeout: float) -> bool:
+        return self._handoff_fetched.wait(timeout)
 
     def close_session(self, session_id: str) -> Dict[str, object]:
         """End one session deliberately (``DELETE /v1/stream/<id>``);

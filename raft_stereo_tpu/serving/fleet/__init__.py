@@ -1,23 +1,40 @@
 """Fleet-scale serving: replicated engines behind a session-sticky
-router with failover, fleet-wide brownout, and the shared executable
-artifact store (serving/persist.py).  See docs/architecture.md §Fleet.
+router with failover, fleet-wide brownout, the shared executable
+artifact store (serving/persist.py), and — round 18 — the operations
+layer: session handoff on graceful drain, an HA router pair over a
+fenced replicated ledger, and pressure-driven replica autoscaling.
+See docs/architecture.md §Fleet.
 
-* ``ring``    — consistent-hash ring (session id -> replica, ~1/N remap)
-* ``replica`` — one fleet member: HTTP client + health state
-* ``router``  — routing, failover, lost-session ledger, fleet brownout
-* ``http``    — the router's HTTP front end (``raft-route``)
+* ``ring``       — consistent-hash ring (session id -> replica, ~1/N remap)
+* ``replica``    — one fleet member: HTTP client + health state
+* ``router``     — routing, failover, lost-session ledger, fleet brownout,
+                   drain handoff, HA roles, xl-capability routing
+* ``ledger``     — the HA pair's fenced lease + append-only ledger
+* ``autoscaler`` — the pressure -> fleet-size control loop + launchers
+* ``http``       — the router's HTTP front end (``raft-route``)
 """
 
+from raft_stereo_tpu.serving.fleet.autoscaler import (AutoscaleConfig,
+                                                      Autoscaler,
+                                                      LocalProcessLauncher,
+                                                      ReplicaLauncher,
+                                                      serve_argv_template)
 from raft_stereo_tpu.serving.fleet.http import (RouterHTTPServer,
-                                                make_router_handler)
+                                                make_router_handler,
+                                                retry_after_jittered)
+from raft_stereo_tpu.serving.fleet.ledger import FleetLedger
 from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
                                                    ReplicaUnreachable)
 from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
 from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
                                                   NoReplicasAvailable,
-                                                  RouterConfig, SessionLost)
+                                                  RouterConfig, SessionLost,
+                                                  XlUnavailable)
 
 __all__ = ["DEFAULT_VNODES", "HashRing", "Replica", "ReplicaHealth",
            "ReplicaUnreachable", "FleetRouter", "NoReplicasAvailable",
-           "RouterConfig", "SessionLost", "RouterHTTPServer",
-           "make_router_handler"]
+           "RouterConfig", "SessionLost", "XlUnavailable",
+           "RouterHTTPServer", "make_router_handler",
+           "retry_after_jittered", "FleetLedger", "Autoscaler",
+           "AutoscaleConfig", "ReplicaLauncher", "LocalProcessLauncher",
+           "serve_argv_template"]

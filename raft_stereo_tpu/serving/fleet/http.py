@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import json
 import logging
+import math
+import random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -43,10 +45,23 @@ from urllib.parse import urlparse
 
 from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
                                                   NoReplicasAvailable,
-                                                  SessionLost)
+                                                  SessionLost,
+                                                  XlUnavailable)
 from raft_stereo_tpu.serving.http import MAX_BODY_BYTES, _stream_session_id
 
 log = logging.getLogger(__name__)
+
+
+def retry_after_jittered(base_s: float = 1.0) -> Tuple[float, str]:
+    """A jittered retry hint for the router's 503s: ``(retry_after_s,
+    header_value)``.  The body carries the precise float in
+    [0.5*base, 1.5*base]; the Retry-After header (integer seconds per
+    RFC 9110) rounds UP so header-only clients never retry early.  The
+    spread exists so N clients that all hit the same no-capacity window
+    do not re-arrive in lockstep and recreate it (the r13 typed-overload
+    contract, plus thundering-herd dispersion)."""
+    retry_s = round(random.uniform(0.5 * base_s, 1.5 * base_s), 2)
+    return retry_s, str(max(1, math.ceil(retry_s)))
 
 
 def make_router_handler(router: FleetRouter):
@@ -118,11 +133,24 @@ def make_router_handler(router: FleetRouter):
                     "replica": e.replica,
                     "detail": str(e)})
                 return
+            except XlUnavailable as e:
+                retry_s, header = retry_after_jittered()
+                self._reply_json(
+                    503, {"error": "xl_unavailable",
+                          "capable_replicas": e.capable_ready,
+                          "capable_total": e.capable_total,
+                          "retry_after_s": retry_s, "detail": str(e)},
+                    extra_headers=[("Retry-After", header)])
+                return
             except NoReplicasAvailable as e:
+                # The r13 typed-overload contract at fleet level: the
+                # machine-readable body plus a JITTERED Retry-After so
+                # synchronized clients do not retry in lockstep.
+                retry_s, header = retry_after_jittered()
                 self._reply_json(
                     503, {"error": "no_replicas_ready",
-                          "retry_after_s": 1.0, "detail": str(e)},
-                    extra_headers=[("Retry-After", "1")])
+                          "retry_after_s": retry_s, "detail": str(e)},
+                    extra_headers=[("Retry-After", header)])
                 return
             self._reply_forwarded(status, h, payload)
 
@@ -136,11 +164,15 @@ def make_router_handler(router: FleetRouter):
                 status = router.fleet_status()
                 self._reply_json(200, {
                     "status": "ok",
+                    "role": status["role"],
+                    "epoch": status["epoch"],
                     "ready_replicas": status["ready"],
                     "total_replicas": status["total"],
                     "in_rotation": status["in_rotation"],
                     "brownout_level": status["brownout_level"],
-                    "sessions_routed": status["sessions_routed"]})
+                    "sessions_routed": status["sessions_routed"],
+                    "sessions_pending_handoff":
+                        status["sessions_pending_handoff"]})
             elif path == "/readyz":
                 status = router.fleet_status()
                 ready = status["ready"] > 0

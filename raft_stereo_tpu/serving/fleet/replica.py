@@ -50,6 +50,17 @@ class ReplicaHealth:
     inflight: int = 0
     brownout_level: int = 0
     sessions_active: Optional[int] = None
+    # XL topology (round 17 /healthz "xl" field): None when this replica
+    # serves without the mesh tier — the router's xl-capability routing
+    # (round 18) keys off this.
+    xl: Optional[Dict] = None
+    # Running totals the autoscaler differences into rates.
+    admitted: int = 0
+    deadline_missed: int = 0
+
+    @property
+    def xl_capable(self) -> bool:
+        return self.xl is not None
 
     @property
     def queue_fraction(self) -> float:
@@ -180,7 +191,27 @@ class Replica:
             queue_limit=int(h.get("queue_limit") or 0),
             inflight=int(h.get("inflight") or 0),
             brownout_level=int(h.get("brownout_level") or 0),
-            sessions_active=h.get("sessions_active"))
+            sessions_active=h.get("sessions_active"),
+            xl=h.get("xl") or None,
+            admitted=int(h.get("admitted") or 0),
+            deadline_missed=int(h.get("deadline_missed") or 0))
+
+    def get_handoff(self, timeout: float) -> Optional[Dict]:
+        """The draining replica's session-handoff manifest (``GET
+        /admin/handoff``): the artifact key + session ids the router
+        remaps to survivors.  None while the replica has not published
+        yet (404 — poll again next pass); raises ``ReplicaUnreachable``
+        on transport failure (the replica may already be gone — the
+        death path takes over)."""
+        status, _, body = self._request("GET", "/admin/handoff", None,
+                                        {}, timeout)
+        if status != 200:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError as e:
+            raise ReplicaUnreachable(
+                self.name, f"/admin/handoff body unparseable: {e}") from e
 
     def post_brownout(self, level: int, timeout: float) -> bool:
         """Push the fleet brownout floor; True when the replica applied

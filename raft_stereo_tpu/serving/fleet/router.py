@@ -34,6 +34,38 @@ unit of failure:
   executable artifact store (serving/persist.py) a replacement replica
   boots warm, so rejoin cost is an artifact fetch, not a compile storm.
 
+Round 18 turns "the fleet survives faults" into "the fleet can be
+OPERATED" (docs/architecture.md §Fleet):
+
+* **Session handoff on graceful drain.**  A replica reporting
+  ``draining`` is pulled from rotation WITHOUT typing its sessions lost:
+  the router polls its ``GET /admin/handoff`` manifest (the draining
+  engine published its live streams into the artifact store's
+  ``sessions/`` namespace), remaps those ids, and tags each one's next
+  frame with ``X-Handoff-Artifact`` so the inheriting replica imports
+  the warm state lazily — a planned restart costs zero 410s and the
+  first post-drain frame still dispatches warm.  A kill -9 keeps the
+  r16 typed-loss path: handoff is for PLANNED drains only.
+* **HA pair.**  Two routers share the deterministic ring by
+  construction plus a fenced, replicated lost-session/handoff ledger
+  (fleet/ledger.py) in the artifact store.  The primary holds a lease
+  and appends ``lost``/``fired``/``handoff`` records; the standby
+  serves traffic the whole time (stateless + ring-sticky sessions need
+  no shared state) and takes over — bump the fencing epoch, replay the
+  ledger — when the lease goes stale or the peer stops answering.  A
+  loss is never un-typed and never double-fired for one id; a stale
+  primary's appends are rejected.
+* **Dynamic membership + pressure export.**  ``add_replica`` /
+  ``remove_replica`` and ``fleet_pressure()`` are the seams the
+  autoscaler (fleet/autoscaler.py) drives: scale-up registers a fresh
+  replica (it joins rotation when its probes go ready), scale-down
+  always DRAINS through the handoff path, never kills.
+* **XL-capability routing.**  ``?tier=xl`` requests route only to
+  replicas whose /healthz advertises the mesh tier; a fleet with none
+  in rotation answers the typed 503 ``xl_unavailable`` with the
+  capable-replica count instead of bouncing the request off a replica
+  that will 400 it.
+
 Pass-through contract: with every replica healthy the router adds no
 behavior — request and response bytes are forwarded verbatim (hop-by-hop
 headers aside), so a one-replica fleet is byte-identical to hitting the
@@ -44,12 +76,16 @@ router -> replica -> engine -> solo).
 from __future__ import annotations
 
 import dataclasses
+import http.client
+import json
 import logging
 import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlparse
 
+from raft_stereo_tpu.serving.fleet.ledger import FleetLedger
 from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
                                                    ReplicaUnreachable)
 from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
@@ -61,6 +97,23 @@ log = logging.getLogger(__name__)
 class NoReplicasAvailable(RuntimeError):
     """No ready replica can take this request right now (the fleet's
     503: every member is dead, warming, or draining)."""
+
+
+class XlUnavailable(NoReplicasAvailable):
+    """Typed xl-capability failure (HTTP 503 ``xl_unavailable``): the
+    request asked for the mesh-sharded xl tier but no replica currently
+    in rotation advertises one.  ``capable_ready`` counts xl replicas
+    in rotation (0 here by definition), ``capable_total`` counts
+    configured replicas whose last probe advertised the tier."""
+
+    def __init__(self, capable_ready: int, capable_total: int,
+                 fleet_size: int):
+        super().__init__(
+            f"no xl-capable replica in rotation ({capable_ready} ready, "
+            f"{capable_total} capable of {fleet_size} configured)")
+        self.capable_ready = capable_ready
+        self.capable_total = capable_total
+        self.fleet_size = fleet_size
 
 
 class SessionLost(KeyError):
@@ -109,6 +162,34 @@ class RouterConfig:
     # Lost-session bookkeeping bound: ids older than this are forgotten
     # even if the client never came back for its 410.
     session_lost_ttl_s: float = 60.0
+    # Capacity cap on the lost-session AND handoff ledgers (the
+    # SessionStore tombstone move, fleet-wide): a long-lived router
+    # under session churn forgets the OLDEST owed 410s/handoffs past
+    # this many, bounding memory; fleet_lost_ledger_size tracks it.
+    session_lost_cap: int = 4096
+    # Bounded wait for a draining replica's /admin/handoff manifest
+    # when one of its sessions' frames arrives before the manifest was
+    # fetched (the export runs at SIGTERM, so this is one export +
+    # one store write away).
+    handoff_fetch_timeout_s: float = 3.0
+    # ---- HA pair (fleet/ledger.py) ------------------------------------
+    # Shared ledger directory (inside the artifact store, e.g.
+    # <store>/fleet).  None: single-router mode, no ledger, everything
+    # below ignored.
+    ha_dir: Optional[str] = None
+    router_name: str = "router"
+    # True: start PASSIVE — serve traffic (stateless + ring-sticky
+    # sessions need no shared state) but hold no lease and append no
+    # ledger records until the primary's lease goes stale (or the peer
+    # stops answering) and this router takes over.
+    standby: bool = False
+    # Lease renewal happens every health poll; the standby takes over
+    # once the lease has not been renewed for this long.
+    lease_ttl_s: float = 3.0
+    # Optional peer URL (the primary, from the standby's side): probing
+    # it detects a kill -9 faster than lease staleness alone.
+    peer_url: Optional[str] = None
+    peer_fail_after: int = 2
 
     def __post_init__(self):
         if self.fail_after < 1:
@@ -123,6 +204,15 @@ class RouterConfig:
                 f"({self.brownout_restore_fraction}) <= "
                 f"brownout_engage_fraction "
                 f"({self.brownout_engage_fraction}) <= 1")
+        if self.session_lost_cap < 1:
+            raise ValueError(f"session_lost_cap={self.session_lost_cap} "
+                             f"must be >= 1")
+        if self.standby and self.ha_dir is None:
+            raise ValueError("standby=True needs ha_dir (the shared "
+                             "lease/ledger directory to watch)")
+        if self.lease_ttl_s <= 0:
+            raise ValueError(f"lease_ttl_s={self.lease_ttl_s} must be "
+                             f"> 0")
 
 
 class FleetRouter:
@@ -155,6 +245,15 @@ class FleetRouter:
         self._session_table: Dict[str, str] = {}
         # sid -> (replica, t_lost): sessions owed one typed 410.
         self._lost: "OrderedDict[str, Tuple[str, float]]" = OrderedDict()
+        # sid -> (artifact_key, t): sessions a draining replica handed
+        # off — their next frame is tagged X-Handoff-Artifact so the
+        # inheriting replica imports the warm state (round 18).
+        self._handoff: "OrderedDict[str, Tuple[str, float]]" = (
+            OrderedDict())
+        # name -> Replica currently draining whose handoff manifest has
+        # not been fetched yet (polled every probe pass, and inline —
+        # bounded — when one of their sessions' frames arrives first).
+        self._drain_pending: Dict[str, Replica] = {}
         self._rr = 0                       # round-robin tiebreak
         self._transitions: List[Dict[str, object]] = []   # audit trail
         # Fleet brownout state.
@@ -195,6 +294,42 @@ class FleetRouter:
         self.brownout_pushes = r.counter(
             "fleet_brownout_pushes_total",
             "brownout floor updates pushed to replicas")
+        self.lost_ledger_size = r.gauge(
+            "fleet_lost_ledger_size",
+            "sessions currently owed a typed 410 in the router's "
+            "lost-session ledger (TTL + capacity bounded)")
+        self.handoff_sessions = r.counter(
+            "fleet_handoff_sessions_total",
+            "sessions remapped to survivors through a draining "
+            "replica's handoff manifest (zero-loss planned restarts)")
+        self.handoff_manifests = r.counter(
+            "fleet_handoff_manifests_total",
+            "drain handoff manifests fetched and applied")
+        self.xl_unroutable = r.counter(
+            "fleet_xl_unroutable_total",
+            "xl-tier requests failed typed (503 xl_unavailable) with "
+            "no xl-capable replica in rotation")
+        self.active_gauge = r.gauge(
+            "fleet_router_active",
+            "1 when this router holds the HA lease (or runs without an "
+            "HA pair), 0 for a passive standby")
+        self.takeovers = r.counter(
+            "fleet_router_takeovers_total",
+            "standby takeovers: lease acquired + ledger replayed after "
+            "the primary went stale/unreachable")
+        # ---- HA pair state (fleet/ledger.py) --------------------------
+        self.ledger: Optional[FleetLedger] = None
+        self.active = True
+        self._peer_failures = 0
+        self._last_compact = 0.0
+        if cfg.ha_dir:
+            self.ledger = FleetLedger(cfg.ha_dir, cfg.router_name,
+                                      clock=time.time)
+            self.active = not cfg.standby
+            if self.active:
+                self.ledger.acquire()
+                self._replay_ledger()
+        self.active_gauge.set(1 if self.active else 0)
         self._routed_lock = threading.Lock()
         self._routed_by_kind: Dict[str, object] = {}
         self._per_replica_lock = threading.Lock()
@@ -240,6 +375,10 @@ class FleetRouter:
                 self.check_replicas()
             except Exception:  # pragma: no cover — loop must not die
                 log.exception("fleet health poll failed")
+            try:
+                self._ha_tick()
+            except Exception:  # pragma: no cover — loop must not die
+                log.exception("fleet HA tick failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -250,15 +389,19 @@ class FleetRouter:
         """One probe pass over every replica (public: tests and the
         smoke call it directly for deterministic stepping).  Probes run
         OUTSIDE the lock; state transitions apply under it."""
+        with self._lock:
+            members = list(self.replicas.items())   # autoscaler mutates
         results: Dict[str, Optional[ReplicaHealth]] = {}
-        for name, rep in self.replicas.items():
+        for name, rep in members:
             try:
                 results[name] = rep.probe(self.cfg.health_timeout_s)
             except ReplicaUnreachable:
                 results[name] = None
         with self._lock:
             for name, health in results.items():
-                rep = self.replicas[name]
+                rep = self.replicas.get(name)
+                if rep is None:         # removed mid-pass (autoscaler)
+                    continue
                 if health is None:
                     rep.consecutive_failures += 1
                     if (rep.alive
@@ -274,6 +417,7 @@ class FleetRouter:
                 in_ring = rep.name in self.ring
                 if health.ready and not in_ring:
                     self.ring.add(rep.name)
+                    self._drain_pending.pop(rep.name, None)
                     self._transitions.append({
                         "t": self._clock(), "replica": rep.name,
                         "event": ("rejoined" if was_dead else "ready")})
@@ -283,10 +427,20 @@ class FleetRouter:
                     if self.brownout_level > 0:
                         self._push_brownout_locked((rep,))
                 elif not health.ready and in_ring:
-                    self._remove_from_rotation_locked(
-                        rep, "draining" if health.draining
-                        else "not_ready", dead=False)
+                    if health.draining:
+                        # Planned drain (round 18): out of rotation but
+                        # its sessions are NOT lost — the handoff
+                        # manifest remaps them (fetched below, outside
+                        # the lock).  A drain that dies before handing
+                        # off falls through to the death path above.
+                        self._begin_drain_locked(rep)
+                    else:
+                        self._remove_from_rotation_locked(
+                            rep, "not_ready", dead=False)
             self._note_ready_locked()
+            pending = list(self._drain_pending.values())
+        for rep in pending:
+            self._fetch_handoff(rep)
         self._brownout_poll()
 
     def _note_ready_locked(self) -> None:
@@ -299,6 +453,7 @@ class FleetRouter:
         and — when ``dead`` — it stays out until a probe succeeds."""
         if dead:
             rep.alive = False
+        self._drain_pending.pop(rep.name, None)
         if rep.name not in self.ring and not dead:
             return
         self.ring.remove(rep.name)
@@ -309,6 +464,9 @@ class FleetRouter:
             del self._session_table[sid]
             self._lost[sid] = (rep.name, now)
             self._lost.move_to_end(sid)
+        if lost:
+            self._ledger_append("lost", sids=lost, replica=rep.name)
+        self._bound_ledgers_locked()
         self.sessions_lost.inc(len(lost))
         self.failovers.inc()
         self._transitions.append({
@@ -319,24 +477,270 @@ class FleetRouter:
                     "lost, %d/%d replicas ready", rep.name, reason,
                     len(lost), len(self.ring), len(self.replicas))
 
+    def _bound_ledgers_locked(self) -> None:
+        """Capacity-cap the lost and handoff tables (oldest forgotten —
+        the SessionStore tombstone bound, fleet-wide) and refresh the
+        fleet_lost_ledger_size gauge."""
+        while len(self._lost) > self.cfg.session_lost_cap:
+            self._lost.popitem(last=False)
+        while len(self._handoff) > self.cfg.session_lost_cap:
+            self._handoff.popitem(last=False)
+        self.lost_ledger_size.set(len(self._lost))
+
     def _expire_lost_locked(self, now: float) -> None:
-        while self._lost:
-            sid, (_rep, t) = next(iter(self._lost.items()))
-            if now - t <= self.cfg.session_lost_ttl_s:
-                break
-            del self._lost[sid]
+        for table in (self._lost, self._handoff):
+            while table:
+                sid, (_x, t) = next(iter(table.items()))
+                if now - t <= self.cfg.session_lost_ttl_s:
+                    break
+                del table[sid]
+        self.lost_ledger_size.set(len(self._lost))
+
+    # ------------------------------------------------------- drain handoff
+    def _begin_drain_locked(self, rep: Replica) -> None:
+        """A replica reported draining: out of rotation NOW (no new
+        frames land on it), sessions kept — the handoff manifest remaps
+        them; only if the process dies without one do they fall through
+        to the typed-loss path."""
+        if rep.name in self.ring:
+            self.ring.remove(rep.name)
+            self._note_ready_locked()
+            self._transitions.append({
+                "t": self._clock(), "replica": rep.name,
+                "event": "draining"})
+            log.info("replica %s draining: out of rotation, awaiting "
+                     "session handoff manifest", rep.name)
+        if rep.name not in self._drain_pending:
+            self._drain_pending[rep.name] = rep
+
+    def _fetch_handoff(self, rep: Replica) -> bool:
+        """One attempt to fetch + apply a draining replica's handoff
+        manifest (outside the lock; retried every probe pass while the
+        replica keeps answering).  True once applied."""
+        try:
+            manifest = rep.get_handoff(self.cfg.health_timeout_s)
+        except ReplicaUnreachable:
+            # Gone already — the probe-failure path converts whatever
+            # is left in the session table to typed losses.
+            return False
+        if manifest is None:
+            return False            # not published yet; poll again
+        sids = [str(s) for s in (manifest.get("sessions") or ())]
+        key = manifest.get("artifact")
+        now = self._clock()
+        with self._lock:
+            if rep.name not in self._drain_pending:
+                return True         # a concurrent fetch won
+            self._drain_pending.pop(rep.name, None)
+            remapped = 0
+            for sid in sids:
+                self._session_table.pop(sid, None)
+                if key:
+                    self._handoff[sid] = (str(key), now)
+                    self._handoff.move_to_end(sid)
+                    remapped += 1
+            self._bound_ledgers_locked()
+            self._transitions.append({
+                "t": now, "replica": rep.name, "event": "handoff",
+                "sessions": remapped})
+        if remapped:
+            self._ledger_append("handoff", sids=sids,
+                                artifact=str(key), replica=rep.name)
+            self.handoff_sessions.inc(remapped)
+        self.handoff_manifests.inc()
+        log.info("replica %s handed off %d session(s) via artifact %s",
+                 rep.name, remapped, key and str(key)[:12])
+        return True
+
+    def _await_drain_handoff(self, session_id: str) -> None:
+        """A frame arrived for a session whose owner is draining but
+        whose manifest has not been fetched yet: fetch it inline,
+        bounded — the alternative is routing the frame cold and losing
+        the warmth the drain carefully exported."""
+        with self._lock:
+            owner = self._session_table.get(session_id)
+            rep = self._drain_pending.get(owner) if owner else None
+        if rep is None:
+            return
+        deadline = self._clock() + self.cfg.handoff_fetch_timeout_s
+        while self._clock() < deadline:
+            if self._fetch_handoff(rep):
+                return
+            with self._lock:
+                if rep.name not in self._drain_pending:
+                    return
+            time.sleep(0.05)
+
+    def _handoff_key(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            entry = self._handoff.get(session_id)
+        return entry[0] if entry else None
+
+    @staticmethod
+    def _draining_503(status: int, payload: bytes) -> bool:
+        """Whether a forwarded response IS the replica's typed draining
+        shed — the race where a frame reached a replica between its
+        SIGTERM and the router's next probe."""
+        if status != 503:
+            return False
+        try:
+            body = json.loads(payload)
+        except ValueError:
+            return False
+        return bool(body.get("error") == "overloaded"
+                    and body.get("draining"))
+
+    # ------------------------------------------------------------- HA pair
+    def _ledger_append(self, kind: str, **fields) -> bool:
+        """Append one record when this router is the ACTIVE ledger
+        writer; silently true in single-router mode (no ledger)."""
+        if self.ledger is None:
+            return True
+        if not self.active:
+            return False
+        ok = self.ledger.append(kind, **fields)
+        if not ok:
+            # Fenced: the peer took over while we were serving.  Demote
+            # — keep forwarding traffic, stop writing shared state.
+            self.active = False
+            self.active_gauge.set(0)
+            log.warning("router %s fenced out of the ledger; demoted "
+                        "to standby", self.cfg.router_name)
+        return ok
+
+    def _replay_ledger(self) -> None:
+        """Rebuild the replicated session-loss/handoff state from the
+        ledger (activation/takeover): owed losses minus fired ones
+        re-arm, fired ones stay fired (never a second 410 for one id),
+        handoffs re-arm the warm remap."""
+        if self.ledger is None:
+            return
+        pending: "OrderedDict[str, str]" = OrderedDict()
+        handoffs: "OrderedDict[str, str]" = OrderedDict()
+        for rec in self.ledger.replay():
+            kind = rec.get("kind")
+            if kind == "lost":
+                for sid in rec.get("sids") or ():
+                    pending[str(sid)] = str(rec.get("replica"))
+                    handoffs.pop(str(sid), None)
+            elif kind == "fired":
+                pending.pop(str(rec.get("sid")), None)
+            elif kind == "handoff":
+                for sid in rec.get("sids") or ():
+                    handoffs[str(sid)] = str(rec.get("artifact"))
+                    pending.pop(str(sid), None)
+        now = self._clock()
+        with self._lock:
+            for sid, replica in pending.items():
+                if sid not in self._lost:
+                    self._lost[sid] = (replica, now)
+            for sid, artifact in handoffs.items():
+                if sid not in self._handoff:
+                    self._handoff[sid] = (artifact, now)
+            self._bound_ledgers_locked()
+        log.info("ledger replayed: %d owed loss(es), %d handoff "
+                 "remap(s) re-armed", len(pending), len(handoffs))
+
+    def _probe_peer(self) -> bool:
+        """One liveness poke at the peer router's /healthz (any HTTP
+        answer counts — we only need to know the process is there)."""
+        url = self.cfg.peer_url
+        if not url:
+            return True
+        parsed = urlparse(url)
+        conn = http.client.HTTPConnection(
+            parsed.hostname or "127.0.0.1", parsed.port or 80,
+            timeout=self.cfg.health_timeout_s)
+        try:
+            conn.request("GET", "/healthz")
+            conn.getresponse().read()
+            return True
+        except (OSError, http.client.HTTPException):
+            return False
+        finally:
+            conn.close()
+
+    def _ha_tick(self) -> None:
+        """One HA heartbeat, from the health loop: the active router
+        renews its lease (and compacts the ledger occasionally); the
+        standby watches lease staleness + the peer and takes over."""
+        if self.ledger is None:
+            return
+        if self.active:
+            if not self.ledger.renew():
+                self.active = False
+                self.active_gauge.set(0)
+                log.warning("router %s lost the lease; now standby",
+                            self.cfg.router_name)
+                return
+            now = time.time()
+            if now - self._last_compact > 60.0:
+                self._last_compact = now
+                self.ledger.compact(
+                    now - 4 * max(self.cfg.session_lost_ttl_s, 60.0))
+            return
+        # Standby: lease staleness is the authoritative signal; a peer
+        # probe failing peer_fail_after times accelerates detection of
+        # a hard kill (and is SAFE — taking over bumps the epoch, so a
+        # merely-partitioned primary is fenced, not duplicated).
+        stale = self.ledger.is_stale(self.cfg.lease_ttl_s)
+        peer_dead = False
+        if self.cfg.peer_url:
+            if self._probe_peer():
+                self._peer_failures = 0
+            else:
+                self._peer_failures += 1
+                peer_dead = (self._peer_failures
+                             >= self.cfg.peer_fail_after)
+        if stale or peer_dead:
+            self.takeover()
+
+    def takeover(self) -> int:
+        """Become the active ledger writer: bump the fencing epoch,
+        replay the ledger, start appending.  Public for tests/ops;
+        idempotent when already active."""
+        if self.ledger is None or self.active:
+            return self.ledger.epoch if self.ledger else 0
+        epoch = self.ledger.acquire()
+        self._replay_ledger()
+        self.active = True
+        self.active_gauge.set(1)
+        self.takeovers.inc()
+        self._peer_failures = 0
+        with self._lock:
+            self._transitions.append({
+                "t": self._clock(), "replica": self.cfg.router_name,
+                "event": "takeover", "epoch": epoch})
+        log.warning("router %s TOOK OVER at epoch %d (lease stale or "
+                    "peer dead); ledger replayed", self.cfg.router_name,
+                    epoch)
+        return epoch
 
     # -------------------------------------------------------------- routing
     def _ready_replicas_locked(self) -> List[Replica]:
         return [r for r in self.replicas.values() if r.ready]
 
-    def pick_stateless(self, exclude: Sequence[str] = ()) -> Replica:
+    def pick_stateless(self, exclude: Sequence[str] = (),
+                       require_xl: bool = False) -> Replica:
         """Least-loaded ready replica (queue depth, then inflight, from
         the last probe), round-robin among equals; raises
-        ``NoReplicasAvailable`` when the rotation is empty."""
+        ``NoReplicasAvailable`` when the rotation is empty.  With
+        ``require_xl`` only replicas whose last probe advertised the
+        mesh tier qualify — none in rotation raises the typed
+        ``XlUnavailable`` instead of bouncing the request off a replica
+        that would 400 it."""
         with self._lock:
             ready = [r for r in self._ready_replicas_locked()
                      if r.name not in exclude]
+            if require_xl:
+                capable_total = sum(
+                    1 for r in self.replicas.values()
+                    if r.health is not None and r.health.xl_capable)
+                ready = [r for r in ready
+                         if r.health is not None and r.health.xl_capable]
+                if not ready:
+                    raise XlUnavailable(0, capable_total,
+                                        len(self.replicas))
             if not ready:
                 raise NoReplicasAvailable(
                     f"no ready replica (fleet of {len(self.replicas)}; "
@@ -357,7 +761,13 @@ class FleetRouter:
             if entry is not None:
                 # Fire-once: the id is forgotten now, so the client's
                 # reseed (the next frame on this or a fresh id) routes
-                # normally and cold-starts on a surviving replica.
+                # normally and cold-starts on a surviving replica.  The
+                # ledger records the delivery FIRST, so an HA peer
+                # replaying after a router kill never fires a second
+                # 410 for this id.
+                self.lost_ledger_size.set(len(self._lost))
+                self._ledger_append("fired", sid=session_id,
+                                    replica=entry[0])
                 raise SessionLost(session_id, entry[0])
             name = self.ring.lookup(session_id)
             if name is None:
@@ -372,6 +782,7 @@ class FleetRouter:
         a close, a 410, or the stream ended)."""
         with self._lock:
             self._session_table.pop(session_id, None)
+            self._handoff.pop(session_id, None)
 
     def note_transport_failure(self, rep: Replica) -> None:
         """A forwarded request hit a transport error on ``rep``: out of
@@ -383,6 +794,20 @@ class FleetRouter:
                 self._remove_from_rotation_locked(rep, "transport_error")
 
     # ----------------------------------------------------------- forwarding
+    @staticmethod
+    def _wants_xl(path_qs: str,
+                  headers: Sequence[Tuple[str, str]]) -> bool:
+        """Whether this request names the xl tier (``?tier=xl`` or the
+        ``X-Tier: xl`` header) — the routing-visible part of the r17
+        tier selection; everything else about the request stays opaque
+        to the router."""
+        query = parse_qs(urlparse(path_qs).query)
+        tiers = query.get("tier")
+        if tiers and tiers[-1] == "xl":
+            return True
+        return any(k.lower() == "x-tier" and v.strip() == "xl"
+                   for k, v in headers)
+
     def forward_stateless(self, method: str, path_qs: str,
                           body: Optional[bytes],
                           headers: Sequence[Tuple[str, str]]
@@ -393,12 +818,20 @@ class FleetRouter:
         function of the request body — the retry is safe), and only
         ``route_retries`` exhausted or an empty rotation surfaces as an
         error.  HTTP error responses are answers, not failures — they
-        forward verbatim, no retry."""
+        forward verbatim, no retry.  Requests naming the xl tier route
+        only to xl-capable replicas (typed ``XlUnavailable`` when the
+        rotation has none)."""
+        require_xl = self._wants_xl(path_qs, headers)
         tried: List[str] = []
         last: Optional[ReplicaUnreachable] = None
         for attempt in range(self.cfg.route_retries):
             try:
-                rep = self.pick_stateless(exclude=tried)
+                rep = self.pick_stateless(exclude=tried,
+                                          require_xl=require_xl)
+            except XlUnavailable:
+                self.xl_unroutable.inc()
+                self.unroutable.inc()
+                raise
             except NoReplicasAvailable:
                 if last is None:
                     self.unroutable.inc()
@@ -425,19 +858,25 @@ class FleetRouter:
             f"all {len(tried)} dispatch attempt(s) hit transport "
             f"failures (tried {tried}): {last}")
 
-    def forward_session(self, session_id: str, method: str, path_qs: str,
-                        body: Optional[bytes],
-                        headers: Sequence[Tuple[str, str]]
-                        ) -> Tuple[int, List[Tuple[str, str]], bytes]:
-        """Forward one session-sticky request.  No transport failover:
-        the session's state lives on exactly one replica, so a transport
-        failure there IS the loss of the session — the replica leaves
-        the rotation and this request (and only this one) fails typed
-        with ``SessionLost``."""
+    def _forward_session_once(self, session_id: str, method: str,
+                              path_qs: str, body: Optional[bytes],
+                              headers: Sequence[Tuple[str, str]]
+                              ) -> Tuple[Replica, int,
+                                         List[Tuple[str, str]], bytes]:
+        """One sticky dispatch: pick the owner, tag the frame with its
+        handoff artifact when the id was handed off, forward."""
         rep = self.pick_session(session_id)   # SessionLost / NoReplicas
+        key = self._handoff_key(session_id)
+        # The router OWNS this header: a client-supplied value must not
+        # reach a replica (it would point the import at an arbitrary
+        # store key).
+        fwd_headers = [(k, v) for k, v in headers
+                       if k.lower() != "x-handoff-artifact"]
+        if key is not None:
+            fwd_headers.append(("X-Handoff-Artifact", key))
         try:
             status, h, payload = rep.forward(
-                method, path_qs, body, headers,
+                method, path_qs, body, fwd_headers,
                 self.cfg.request_timeout_s)
         except ReplicaUnreachable:
             self.note_transport_failure(rep)
@@ -447,11 +886,119 @@ class FleetRouter:
                 # 410 fires exactly once, right now.
                 self._session_table.pop(session_id, None)
                 self._lost.pop(session_id, None)
+                self._handoff.pop(session_id, None)
+                self.lost_ledger_size.set(len(self._lost))
+            self._ledger_append("fired", sid=session_id,
+                                replica=rep.name)
             raise SessionLost(session_id, rep.name) from None
+        if key is not None and status == 200:
+            # Adopted: the inheriting replica now owns the live state.
+            with self._lock:
+                self._handoff.pop(session_id, None)
+        return rep, status, h, payload
+
+    def forward_session(self, session_id: str, method: str, path_qs: str,
+                        body: Optional[bytes],
+                        headers: Sequence[Tuple[str, str]]
+                        ) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """Forward one session-sticky request.  No transport failover:
+        the session's state lives on exactly one replica, so a transport
+        failure there IS the loss of the session — the replica leaves
+        the rotation and this request (and only this one) fails typed
+        with ``SessionLost``.  Planned drains are different: a frame
+        that races the drain (typed 503 draining answer, or an owner
+        whose manifest is still in flight) waits for the handoff
+        manifest — bounded — and retries ONCE on the inheriting replica,
+        so a rolling restart is zero-loss even for frames already in
+        the air."""
+        self._await_drain_handoff(session_id)
+        rep, status, h, payload = self._forward_session_once(
+            session_id, method, path_qs, body, headers)
+        if self._draining_503(status, payload):
+            # The frame beat the router's probe to a draining replica.
+            # Treat the typed shed AS the drain signal: out of
+            # rotation, fetch the manifest (bounded), re-pick — the
+            # ring now maps the id to a survivor — and retry the frame
+            # there with its handoff tag.  The draining replica never
+            # admitted it, so the retry cannot double-dispatch.
+            with self._lock:
+                self._begin_drain_locked(rep)
+            self._await_drain_handoff_for(rep)
+            retry_rep, status, h, payload = self._forward_session_once(
+                session_id, method, path_qs, body, headers)
+            log.info("session %s frame raced replica %s's drain; "
+                     "re-routed to %s", session_id, rep.name,
+                     retry_rep.name)
+            rep = retry_rep
         self._note_routed("session", rep.name)
         if status == 410 or (method == "DELETE" and status == 200):
             self.forget_session(session_id)
         return status, h, payload
+
+    def _await_drain_handoff_for(self, rep: Replica) -> None:
+        """Bounded manifest wait for one specific draining replica."""
+        deadline = self._clock() + self.cfg.handoff_fetch_timeout_s
+        while self._clock() < deadline:
+            with self._lock:
+                if rep.name not in self._drain_pending:
+                    return
+            if self._fetch_handoff(rep):
+                return
+            time.sleep(0.05)
+
+    # ----------------------------------------------------- fleet membership
+    def add_replica(self, name: str, url: str) -> Replica:
+        """Register a new fleet member at runtime (the autoscaler's
+        scale-up seam).  It joins the rotation when its probes go ready
+        — no traffic lands on it before /readyz opens."""
+        with self._lock:
+            if name in self.replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            rep = Replica(name, url)
+            rep.alive = False        # in rotation only after a probe
+            self.replicas[name] = rep
+            self.replicas_total.set(len(self.replicas))
+            self._transitions.append({
+                "t": self._clock(), "replica": name, "event": "added"})
+        log.info("replica %s added at %s (%d configured)", name, url,
+                 len(self.replicas))
+        return rep
+
+    def remove_replica(self, name: str) -> None:
+        """Deregister a fleet member (the autoscaler's post-drain
+        cleanup).  Sessions still mapped to it — there should be none
+        after a handoff — fail typed, never silently."""
+        with self._lock:
+            rep = self.replicas.get(name)
+            if rep is None:
+                return
+            self._remove_from_rotation_locked(rep, "deregistered")
+            del self.replicas[name]
+            self.replicas_total.set(len(self.replicas))
+            self._note_ready_locked()
+
+    def fleet_pressure(self) -> Dict[str, object]:
+        """The aggregate pressure signal the autoscaler consumes:
+        queued fraction across ready replicas (None when nothing
+        reports a limit), the fleet brownout level, and the summed
+        admitted/deadline-miss totals (the caller differences them
+        into a rate)."""
+        with self._lock:
+            admitted = missed = 0
+            for rep in self._ready_replicas_locked():
+                if rep.health is None:
+                    continue
+                admitted += rep.health.admitted
+                missed += rep.health.deadline_missed
+            return {
+                "queued_fraction": self._fleet_pressure_locked(),
+                "brownout_level": self.brownout_level,
+                "brownout_max_level": self.cfg.brownout_max_level,
+                "admitted_total": admitted,
+                "deadline_missed_total": missed,
+                "ready": len(self.ring),
+                "total": len(self.replicas),
+            }
 
     # -------------------------------------------------------- fleet brownout
     def _fleet_pressure_locked(self) -> Optional[float]:
@@ -541,6 +1088,11 @@ class FleetRouter:
                 "total": len(self.replicas),
                 "sessions_routed": len(self._session_table),
                 "sessions_pending_loss": len(self._lost),
+                "sessions_pending_handoff": len(self._handoff),
+                "draining_replicas": sorted(self._drain_pending),
                 "brownout_level": self.brownout_level,
+                "role": ("single" if self.ledger is None
+                         else "primary" if self.active else "standby"),
+                "epoch": self.ledger.epoch if self.ledger else None,
                 "transitions": list(self._transitions[-50:]),
             }

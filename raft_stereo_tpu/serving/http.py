@@ -210,6 +210,11 @@ def make_handler(service: StereoService,
                     "queue_depth": service.queue.depth,
                     "queue_limit": service.serve_cfg.max_queue,
                     "inflight": service.metrics.inflight.value,
+                    # Running totals the fleet autoscaler differences
+                    # into a deadline-miss RATE (fleet/autoscaler.py).
+                    "admitted": service.metrics.admitted.value,
+                    "deadline_missed":
+                        service.metrics.deadline_missed.value,
                     "last_batch_age_s":
                         service.metrics.last_batch_age_s(),
                     "anomalies": service.metrics.anomalies.value,
@@ -225,6 +230,18 @@ def make_handler(service: StereoService,
                 status["status"] = ("ready" if status["ready"]
                                     else "warming")
                 self._reply_json(200 if status["ready"] else 503, status)
+            elif path == "/admin/handoff":
+                # The drain handoff manifest (round 18): after a
+                # graceful SIGTERM published the session blob, the
+                # fleet router reads WHICH ids moved and which artifact
+                # key carries their state; 404 until then (the router
+                # polls while the replica reports draining).
+                manifest = getattr(service, "handoff_manifest", None)
+                if manifest is None:
+                    self._reply_json(404, {"error": "no_handoff"})
+                else:
+                    service.note_handoff_fetched()
+                    self._reply_json(200, manifest)
             elif handle_debug_get(path, url.query, service.tracer, recorder,
                                   service.metrics.registry,
                                   self._reply, self._reply_json,
@@ -319,7 +336,9 @@ def make_handler(service: StereoService,
                 if session_id is not None:
                     result = service.infer_session(
                         session_id, left, right, deadline_ms=deadline_ms,
-                        tier=tier, degradable=degradable)
+                        tier=tier, degradable=degradable,
+                        handoff_key=self.headers.get(
+                            "X-Handoff-Artifact"))
                 else:
                     result = service.infer(left, right,
                                            deadline_ms=deadline_ms,

@@ -201,6 +201,20 @@ class ServingMetrics:
             "session frames served with the cached context bundle (the "
             "context encoder never ran — session_ctx_cache; the "
             "X-Ctx-Cached response header marks these)")
+        self.sessions_exported = r.counter(
+            "serve_sessions_exported_total",
+            "streaming sessions serialized into a graceful-drain "
+            "handoff blob (engine.publish_handoff — these streams move "
+            "to a survivor instead of 410ing)")
+        self.sessions_adopted = r.counter(
+            "serve_sessions_adopted_total",
+            "streaming sessions whose state was imported from another "
+            "replica's handoff blob at the session's first frame here "
+            "(X-Handoff-Artifact; the frame dispatches WARM)")
+        self.handoff_import_skipped = r.counter(
+            "serve_handoff_import_skipped_total",
+            "handoff entries that failed their checksum / parse and "
+            "degraded to a cold start (never a crash)")
         self.frame_delta = r.histogram(
             "serve_session_frame_delta",
             "mean |delta intensity| (0..255) between consecutive session "

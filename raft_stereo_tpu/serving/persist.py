@@ -46,6 +46,12 @@ fetch-bound instead of compile-bound:
   persistent compilation cache in the same directory, which also covers
   compiles that do not route through the AOT path.
 
+* ``SessionHandoffStore`` — the store's ``sessions/`` namespace (round
+  18): serialized SessionStore blobs a draining replica publishes so
+  its live streams survive a planned restart (docs/architecture.md
+  §Fleet, "Session handoff").  Content-hash keys, atomic writes,
+  TTL-bounded, and the same can-only-cost-warmth degradation contract.
+
 Degradation contract (same as telemetry/costs.py): serialization that
 fails for any reason — backend without serialization support, pickle
 drift across versions, a corrupt/truncated cache file — logs once and
@@ -63,6 +69,7 @@ import logging
 import os
 import pickle
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
@@ -329,6 +336,106 @@ class ExecutableDiskCache:
                     "misses": self.misses, "evictions": self.evictions,
                     "disabled": int(self.disabled),
                     "read_only": int(self.read_only)}
+
+
+class SessionHandoffStore:
+    """The artifact store's ``sessions/`` namespace (round 18): a
+    gracefully draining replica publishes its serialized session blob
+    here (serving/sessions.py ``SessionStore.export``), the router hands
+    the content key to whichever survivors inherit those ids
+    (``X-Handoff-Artifact``), and the receiving replica fetches the blob
+    lazily at the session's next frame.
+
+    Same degradation contract as the executable store above: a handoff
+    that cannot be written, read, or parsed costs warmth (those sessions
+    cold-start), never correctness or uptime.  Keys are SHA-256 content
+    hashes, writes are atomic, and ``gc`` ages published blobs out after
+    ``ttl_s`` — a handoff is only useful for about one session TTL, so
+    the namespace is self-bounding under rolling restarts.
+    """
+
+    SUFFIX = ".sessions"
+
+    def __init__(self, store_dir: str, ttl_s: float = 600.0,
+                 read_only: bool = False):
+        self.dir = os.path.join(
+            os.path.abspath(os.path.expanduser(store_dir)), "sessions")
+        self.ttl_s = ttl_s
+        self.read_only = read_only
+        if not read_only:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+            except OSError:
+                log.warning("cannot create session handoff namespace %s",
+                            self.dir, exc_info=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}{self.SUFFIX}")
+
+    def publish(self, blob: bytes) -> Optional[str]:
+        """Write one handoff blob; returns its content key, or None when
+        the write failed (the drain proceeds — its sessions fail over to
+        the r16 typed-loss path instead)."""
+        if self.read_only:
+            return None
+        key = hashlib.sha256(blob).hexdigest()
+        path = self._path(key)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            log.warning("could not publish session handoff %s", path,
+                        exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        self.gc()
+        return key
+
+    def fetch(self, key: str) -> Optional[bytes]:
+        """The blob for ``key``, or None (missing / unreadable / key
+        fails the content-hash check — a torn or tampered file must not
+        reach the parser as trusted state)."""
+        try:
+            with open(self._path(key), "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        if hashlib.sha256(blob).hexdigest() != key:
+            log.warning("session handoff %s fails its content hash; "
+                        "ignoring", key)
+            return None
+        return blob
+
+    def gc(self) -> int:
+        """Drop handoff blobs older than ``ttl_s`` (mtime); returns the
+        count removed."""
+        if self.read_only:
+            return 0
+        removed = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        cutoff = time.time() - self.ttl_s
+        for name in names:
+            if not name.endswith(self.SUFFIX):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                if os.stat(path).st_mtime < cutoff:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                continue
+        return removed
 
 
 def enable_persistent_compilation_cache(cache_dir: str) -> bool:

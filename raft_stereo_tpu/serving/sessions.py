@@ -36,6 +36,17 @@ Design points:
   consecutive frames' mean-pooled grayscale thumbnails — is compared
   against the engine's threshold; a cut falls back to a cold start (and
   the session keeps streaming: state re-seeds from the cold frame).
+* **Handoff serialization** (round 18).  ``export()``/``import_()``
+  round-trip the whole store through a VERSIONED, per-entry-CHECKSUMMED
+  blob so a gracefully draining replica can hand its live streams to a
+  survivor through the shared artifact store instead of 410ing them
+  (serving/engine.py ``publish_handoff``, fleet/router.py drain remap).
+  The format is deliberately paranoid: a self-describing header, one
+  SHA-256 per session over its metadata AND its array payload, and
+  pickle-free numpy encoding — a corrupt, truncated, or
+  version-mismatched entry degrades that ONE session to a cold start
+  (skipped, counted), never crashes the importer, and never installs a
+  torn disparity field as a warm init.
 
 The store never touches JAX: like the batcher, every policy here is
 testable in milliseconds (tests/test_sessions.py).
@@ -44,12 +55,19 @@ testable in milliseconds (tests/test_sessions.py).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import io
+import json
+import logging
+import struct
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 # Pooling factor of the scene-cut thumbnails: coarse enough that the
 # per-frame host cost is trivial (~Kb), fine enough that a real scene
@@ -97,6 +115,163 @@ def frame_delta(thumb_a: Optional[np.ndarray],
     if thumb_a is None or thumb_b is None or thumb_a.shape != thumb_b.shape:
         return None
     return float(np.mean(np.abs(thumb_a - thumb_b)))
+
+
+# -------------------------------------------------------------- handoff
+# Blob layout: MAGIC + u16 version + u32 manifest length + manifest JSON
+# + concatenated array payload.  The manifest lists one entry per
+# session: its metadata, the [offset, offset+length) payload slice its
+# arrays occupy, and a SHA-256 over (canonical metadata JSON + slice).
+# Arrays are packed as plain ``np.save`` segments (allow_pickle=False on
+# the way back in) under a tiny recursive tree spec, so the ctx bundle's
+# nested tuples survive without pickle.
+HANDOFF_MAGIC = b"RSTPU-SESS"
+HANDOFF_VERSION = 1
+
+# StereoSession counters that ride the handoff verbatim.
+_RECORD_COUNTERS = ("frame_index", "warm_frames", "cold_frames",
+                    "scene_cuts", "ctx_hits", "iters_used_sum",
+                    "iters_used_frames")
+
+
+def _pack_tree(obj, out: io.BytesIO):
+    """Spec node for one array tree: ndarray leaves become np.save
+    segments appended to ``out`` (offsets relative to the session's
+    payload slice); tuples/lists recurse; None passes through.  Raises
+    ``TypeError`` on anything else — the caller decides whether that
+    drops the leaf's whole tree (ctx) or the session."""
+    if obj is None:
+        return {"k": "none"}
+    if isinstance(obj, np.ndarray):
+        start = out.tell()
+        np.save(out, obj, allow_pickle=False)
+        return {"k": "nd", "o": start, "n": out.tell() - start}
+    if isinstance(obj, (tuple, list)):
+        return {"k": "tuple" if isinstance(obj, tuple) else "list",
+                "items": [_pack_tree(x, out) for x in obj]}
+    raise TypeError(f"unserializable handoff leaf: {type(obj).__name__}")
+
+
+def _unpack_tree(spec, payload: bytes):
+    kind = spec["k"]
+    if kind == "none":
+        return None
+    if kind == "nd":
+        seg = payload[spec["o"]:spec["o"] + spec["n"]]
+        return np.load(io.BytesIO(seg), allow_pickle=False)
+    if kind in ("tuple", "list"):
+        items = [_unpack_tree(s, payload) for s in spec["items"]]
+        return tuple(items) if kind == "tuple" else items
+    raise ValueError(f"unknown handoff tree node {kind!r}")
+
+
+def _entry_digest(meta: Dict[str, object], payload: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(json.dumps(meta, sort_keys=True, default=str).encode())
+    h.update(payload)
+    return h.hexdigest()
+
+
+def export_sessions_blob(records: Iterable[Tuple[Dict[str, object],
+                                                 Dict[str, object]]]
+                         ) -> bytes:
+    """Serialize ``(meta, arrays)`` session records (see
+    ``StereoSession.to_record``) into one handoff blob."""
+    entries: List[Dict[str, object]] = []
+    body = io.BytesIO()
+    for meta, arrays in records:
+        seg = io.BytesIO()
+        spec: Dict[str, object] = {}
+        for name in ("flow_low", "thumb"):
+            spec[name] = _pack_tree(arrays.get(name), seg)
+        try:
+            spec["ctx"] = _pack_tree(arrays.get("ctx"), seg)
+        except (TypeError, ValueError, OSError):
+            # The ctx bundle can carry backend-exotic leaves (bf16 via
+            # ml_dtypes) np.save may refuse.  Warmth only needs the
+            # flow: drop the bundle, it re-establishes at the next cold
+            # ctx frame on the importer.
+            seg.seek(0)
+            seg.truncate()
+            spec = {name: _pack_tree(arrays.get(name), seg)
+                    for name in ("flow_low", "thumb")}
+            spec["ctx"] = {"k": "none"}
+        payload = seg.getvalue()
+        entries.append({"id": meta["session_id"], "meta": meta,
+                        "spec": spec, "offset": body.tell(),
+                        "length": len(payload),
+                        "sha256": _entry_digest(meta, payload)})
+        body.write(payload)
+    manifest = json.dumps({"version": HANDOFF_VERSION,
+                           "sessions": entries}).encode()
+    return (HANDOFF_MAGIC + struct.pack("<HI", HANDOFF_VERSION,
+                                        len(manifest))
+            + manifest + body.getvalue())
+
+
+def handoff_session_ids(blob: bytes) -> List[str]:
+    """The session ids a handoff blob claims to carry (header-only read;
+    [] on anything unparseable)."""
+    manifest = _handoff_manifest(blob)
+    if manifest is None:
+        return []
+    return [str(e.get("id")) for e in manifest.get("sessions", ())]
+
+
+def _handoff_manifest(blob: bytes) -> Optional[Dict[str, object]]:
+    try:
+        if not blob.startswith(HANDOFF_MAGIC):
+            return None
+        off = len(HANDOFF_MAGIC)
+        version, mlen = struct.unpack_from("<HI", blob, off)
+        if version != HANDOFF_VERSION:
+            log.warning("handoff blob version %d != %d; ignoring "
+                        "(sessions cold-start)", version, HANDOFF_VERSION)
+            return None
+        start = off + struct.calcsize("<HI")
+        return json.loads(blob[start:start + mlen])
+    except (struct.error, ValueError, UnicodeDecodeError):
+        log.warning("unparseable handoff blob header; ignoring "
+                    "(sessions cold-start)", exc_info=True)
+        return None
+
+
+def parse_handoff_blob(blob: bytes
+                       ) -> Tuple[Dict[str, Tuple[Dict[str, object],
+                                                  Dict[str, object]]],
+                                  int]:
+    """Decode a handoff blob into ``{sid: (meta, arrays)}`` plus the
+    count of entries SKIPPED (checksum mismatch, truncation, undecodable
+    arrays).  Never raises: total garbage returns ``({}, 0)`` — the
+    affected sessions simply cold-start, which is the r14 baseline, not
+    a failure."""
+    manifest = _handoff_manifest(blob)
+    if manifest is None:
+        return {}, 0
+    # The header's manifest length field is authoritative
+    # (re-serializing the parsed manifest need not be byte-identical).
+    _, mlen = struct.unpack_from("<HI", blob, len(HANDOFF_MAGIC))
+    body_start = len(HANDOFF_MAGIC) + struct.calcsize("<HI") + mlen
+    body = blob[body_start:]
+    out: Dict[str, Tuple[Dict[str, object], Dict[str, object]]] = {}
+    skipped = 0
+    for entry in manifest.get("sessions", ()):
+        try:
+            payload = body[entry["offset"]:entry["offset"]
+                           + entry["length"]]
+            if len(payload) != entry["length"]:
+                raise ValueError("truncated payload slice")
+            meta = entry["meta"]
+            if _entry_digest(meta, payload) != entry["sha256"]:
+                raise ValueError("checksum mismatch")
+            arrays = {name: _unpack_tree(entry["spec"][name], payload)
+                      for name in ("flow_low", "thumb", "ctx")}
+            out[str(entry["id"])] = (meta, arrays)
+        except Exception:   # noqa: BLE001 — per-entry degradation
+            skipped += 1
+            log.warning("handoff entry %r corrupt; that session will "
+                        "cold-start", entry.get("id"), exc_info=True)
+    return out, skipped
 
 
 @dataclasses.dataclass
@@ -155,6 +330,36 @@ class StereoSession:
         if iters_used is not None:
             self.iters_used_sum += int(iters_used)
             self.iters_used_frames += 1
+
+    def to_record(self) -> Tuple[Dict[str, object], Dict[str, object]]:
+        """``(meta, arrays)`` snapshot for the handoff blob.  The caller
+        must hold ``order_lock`` (the exporter does), so the fields are
+        a consistent post-frame state, never a torn mid-dispatch one."""
+        meta: Dict[str, object] = {"session_id": self.session_id,
+                                   "bucket": (list(self.bucket)
+                                              if self.bucket else None),
+                                   "raw_shape": (list(self.raw_shape)
+                                                 if self.raw_shape
+                                                 else None)}
+        for name in _RECORD_COUNTERS:
+            meta[name] = int(getattr(self, name))
+        return meta, {"flow_low": self.flow_low, "thumb": self.thumb,
+                      "ctx": self.ctx}
+
+    def apply_record(self, meta: Dict[str, object],
+                     arrays: Dict[str, object]) -> None:
+        """Install a handed-off state into this (fresh) session: the
+        next frame then warm-starts exactly as if the previous frame had
+        completed locally.  Caller holds ``order_lock``."""
+        self.bucket = (tuple(meta["bucket"]) if meta.get("bucket")
+                       else None)
+        self.raw_shape = (tuple(meta["raw_shape"])
+                          if meta.get("raw_shape") else None)
+        for name in _RECORD_COUNTERS:
+            setattr(self, name, int(meta.get(name, 0)))
+        self.flow_low = arrays.get("flow_low")
+        self.thumb = arrays.get("thumb")
+        self.ctx = arrays.get("ctx")
 
     def iters_used_mean(self) -> Optional[float]:
         """Per-session mean GRU trip count — the number the close stats
@@ -320,6 +525,63 @@ class SessionStore:
             self._bury(sid, "closed", now)
             self._note_active()
         return sess.stats()
+
+    # -------------------------------------------------------------- handoff
+    def export(self) -> bytes:
+        """Serialize every live session into one versioned, checksummed
+        handoff blob (the graceful-drain path; engine.publish_handoff).
+        Acquires each session's ordering lock, so a frame still in
+        flight completes — and folds its state in — before that session
+        is captured; with admission already stopped (begin_shutdown)
+        every lock wait is bounded by one frame's latency."""
+        with self._lock:
+            self._sweep_locked(self._clock())
+            sessions = list(self._sessions.values())
+        records = []
+        for sess in sessions:
+            with sess.order_lock:
+                records.append(sess.to_record())
+        return export_sessions_blob(records)
+
+    def import_(self, blob: bytes,
+                overwrite: bool = False) -> Tuple[int, int]:
+        """Bulk-install a handoff blob's sessions; returns ``(imported,
+        skipped)``.  Corrupt entries, tombstoned ids, and (without
+        ``overwrite``) ids already live here are skipped — an import can
+        only ever ADD warmth, never clobber a stream this store is
+        actively serving or resurrect one it deliberately killed."""
+        records, skipped = parse_handoff_blob(blob)
+        now = self._clock()
+        imported = 0
+        with self._lock:
+            self._sweep_locked(now)
+            for sid, (meta, arrays) in records.items():
+                if sid in self._tombstones:
+                    skipped += 1
+                    continue
+                if sid in self._sessions and not overwrite:
+                    skipped += 1
+                    continue
+                sess = StereoSession(session_id=sid, created_mono=now,
+                                     last_used_mono=now)
+                sess.apply_record(meta, arrays)
+                while len(self._sessions) >= self.capacity \
+                        and sid not in self._sessions:
+                    evicted_id, _ = self._sessions.popitem(last=False)
+                    self._bury(evicted_id, "evicted", now)
+                self._sessions[sid] = sess
+                self._sessions.move_to_end(sid)
+                imported += 1
+            self._note_active()
+        return imported, skipped
+
+    def adopt(self, sess: StereoSession, meta: Dict[str, object],
+              arrays: Dict[str, object]) -> None:
+        """Install one handed-off record into an already-created session
+        (the LAZY import path: the engine creates the session at the
+        frame's arrival and adopts state before deciding warm vs cold).
+        Caller holds the session's ordering lock."""
+        sess.apply_record(meta, arrays)
 
     def sweep(self) -> None:
         """Eagerly expire TTL-stale sessions (every access sweeps too —

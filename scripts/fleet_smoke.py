@@ -31,9 +31,26 @@ router:
    flips 503 (router out-of-rotation signal) while every admitted
    request still answers 200, then the process exits 0.
 
-Writes ``bench_record`` JSON to FLEET_OUT (default FLEET_r16.json; CI
-pins FLEET_ci.json and uploads it).  Exit 0 on success, non-zero with a
-diagnostic on any violation.
+Round-18 legs (a SECOND fresh fleet + subprocess ``raft-route`` pair):
+
+7. **Rolling restart with session handoff** — a replica holding live
+   streams is SIGTERMed; every stream's next frame answers 200 with
+   ZERO 410s and every handed-off stream's first post-drain frame
+   dispatches on the WARM family (X-Warm: 1) on a survivor.
+8. **Router kill -9 with standby takeover** — the primary ``raft-route``
+   process is SIGKILLed mid-traffic; all 60/60 stateless requests
+   answer (clients fail over to the standby URL), and the standby
+   takes the ledger lease within the probe window.
+9. **Autoscale up, drain down** — a load step pushes the aggregate
+   pressure past the engage watermark, the autoscaler launches a
+   replica (it boots warm from the store and joins rotation); the load
+   stops, the scale-down DRAINS it via handoff, and zero typed session
+   losses occur.
+
+Writes ``bench_record`` JSON to FLEET_OUT (default FLEET_r16.json) and
+the HA legs to FLEET_HA_OUT (default FLEET_HA_r18.json; CI pins
+FLEET_ci.json / FLEET_HA_ci.json and uploads both).  Exit 0 on success,
+non-zero with a diagnostic on any violation.
 
 Run from the repo root:  JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 """
@@ -61,6 +78,8 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 sys.path.insert(0, os.path.join(_REPO, "tools"))
 
 OUT = os.environ.get("FLEET_OUT", os.path.join(_REPO, "FLEET_r16.json"))
+HA_OUT = os.environ.get("FLEET_HA_OUT",
+                        os.path.join(_REPO, "FLEET_HA_r18.json"))
 
 HW = (48, 64)
 ITERS = 2
@@ -182,6 +201,339 @@ class ReplicaProc:
             self.proc.kill()
             self.proc.wait(timeout=30)
         self._log.close()
+
+
+class RouterProc:
+    """One raft-route subprocess (the HA legs need REAL router
+    processes so kill -9 means kill -9)."""
+
+    def __init__(self, name: str, workdir: str, replicas: dict,
+                 ha_dir=None, standby=False, peer=None):
+        self.name = name
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.log_path = os.path.join(workdir, f"{name}.log")
+        self._log = open(self.log_path, "wb")
+        argv = [sys.executable, "-m", "raft_stereo_tpu.cli.route",
+                "--host", "127.0.0.1", "--port", str(self.port),
+                "--name", name, "--health_poll_s", "0.2",
+                "--fail_after", "2", "--request_timeout_s", "300",
+                "--no-fleet_brownout", "--lease_ttl_s", "2.0"]
+        for rname, url in replicas.items():
+            argv += ["--replica", f"{rname}={url}"]
+        if ha_dir:
+            argv += ["--ha_dir", ha_dir]
+        if standby:
+            argv += ["--standby"]
+        if peer:
+            argv += ["--peer", peer]
+        self.proc = subprocess.Popen(
+            argv, cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=self._log, stderr=self._log)
+
+    def wait_ready(self, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"router {self.name} exited rc="
+                    f"{self.proc.returncode}; log:\n{self.log_tail()}")
+            try:
+                if _get(f"{self.url}/readyz", timeout=5)[0] == 200:
+                    return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.1)
+        raise RuntimeError(f"router {self.name} never ready; log:\n"
+                           f"{self.log_tail()}")
+
+    def role(self):
+        try:
+            return json.loads(_get(f"{self.url}/healthz",
+                                   timeout=5)[2])["role"]
+        except (urllib.error.URLError, OSError, ValueError, KeyError):
+            return None
+
+    def log_tail(self, n=4000):
+        self._log.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read()[-n:].decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    def kill9(self):
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def cleanup(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        self._log.close()
+
+
+def _post_failover(urls, path, data, headers):
+    """POST trying each router URL in order — the client side of an HA
+    pair (a VIP/LB in production, explicit failover here)."""
+    last = None
+    for url in urls:
+        try:
+            return _post(f"{url}{path}", data, headers)
+        except (ConnectionError, urllib.error.URLError, OSError) as e:
+            if isinstance(e, urllib.error.HTTPError):
+                raise           # an HTTP answer is an answer
+            last = e
+    raise last
+
+
+def ha_phase(ckpt: str, store: str, workdir: str, payload: bytes,
+             d_body: bytes) -> dict:
+    """Round-18 legs on a fresh fleet: rolling-restart handoff, router
+    kill -9 with standby takeover, autoscale up/drain down."""
+    from raft_stereo_tpu.serving.fleet import (AutoscaleConfig, Autoscaler,
+                                               FleetRouter,
+                                               LocalProcessLauncher,
+                                               RouterConfig,
+                                               serve_argv_template)
+
+    record = {}
+    replicas = []
+    routers = []
+    launcher = None
+    router_c = None
+    try:
+        # ---- fresh 3-replica fleet + subprocess router pair ----------
+        replicas = [ReplicaProc(f"h{i}", ckpt, store, workdir)
+                    for i in range(3)]
+        for r in replicas:
+            r.wait_ready()
+        rep_map = {r.name: r.url for r in replicas}
+        ha_dir = os.path.join(store, "fleet")
+        primary = RouterProc("rt-a", workdir, rep_map, ha_dir=ha_dir)
+        primary.wait_ready()
+        standby = RouterProc("rt-b", workdir, rep_map, ha_dir=ha_dir,
+                             standby=True, peer=primary.url)
+        standby.wait_ready()
+        routers = [primary, standby]
+        urls = [primary.url, standby.url]
+        assert primary.role() == "primary" and standby.role() == "standby"
+
+        # ---- leg 8: rolling restart with handoff ---------------------
+        sids = [f"ha-cam-{i}" for i in range(6)]
+        for sid in sids:
+            for _ in range(2):
+                status, headers, _ = _post_failover(
+                    urls, f"/v1/stream/{sid}?tier=quality", payload,
+                    {"Content-Type": "application/x-npz"})
+                assert status == 200
+        # ownership from the deterministic ring (both routers agree)
+        from raft_stereo_tpu.serving.fleet import HashRing
+        ring = HashRing(sorted(rep_map))
+        owner = {sid: ring.lookup(sid) for sid in sids}
+        victim = next(r for r in replicas
+                      if any(o == r.name for o in owner.values()))
+        moved = [s for s in sids if owner[s] == victim.name]
+        print(f"[fleet_smoke] HA fleet up; rolling-restarting "
+              f"{victim.name} with {len(moved)} live stream(s)",
+              flush=True)
+        victim.terminate()          # SIGTERM: the PLANNED restart
+        status_410 = 0
+        warm_first = 0
+        results = {}
+        for sid in sids:            # every stream's next frame, NOW —
+            try:                    # racing the drain on purpose
+                status, headers, _ = _post_failover(
+                    urls, f"/v1/stream/{sid}?tier=quality", payload,
+                    {"Content-Type": "application/x-npz"})
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
+                    status_410 += 1
+                    continue
+                raise
+            results[sid] = headers
+            if sid in moved and headers.get("X-Warm") == "1":
+                warm_first += 1
+        victim.proc.wait(timeout=120)
+        assert status_410 == 0, (
+            f"a rolling restart produced {status_410} typed 410(s) — "
+            f"handoff must make planned drains zero-loss "
+            f"(victim log:\n{victim.log_tail()})")
+        assert len(results) == len(sids)
+        assert warm_first == len(moved), (
+            f"only {warm_first}/{len(moved)} handed-off streams "
+            f"dispatched WARM on their first post-drain frame "
+            f"(victim log:\n{victim.log_tail()})")
+        assert victim.proc.returncode == 0
+        print(f"[fleet_smoke] rolling restart: 0x410, {warm_first}/"
+              f"{len(moved)} handed-off streams warm on frame 1",
+              flush=True)
+        record["rolling_restart"] = {
+            "streams": len(sids), "moved": len(moved),
+            "typed_410": 0, "warm_first_frames": warm_first,
+            "drain_exit_code": victim.proc.returncode}
+
+        # ---- leg 9: router kill -9, standby takeover -----------------
+        answered = 0
+        t_kill = None
+        for i in range(N_STATELESS):
+            if i == KILL_AFTER:
+                t_kill = time.monotonic()
+                primary.kill9()
+            status, _, body = _post_failover(
+                urls, "/v1/disparity", payload,
+                {"Content-Type": "application/x-npz"})
+            assert status == 200 and body == d_body, \
+                f"stateless request {i} failed across the router kill"
+            answered += 1
+        takeover_deadline = time.monotonic() + 15
+        while (standby.role() != "primary"
+               and time.monotonic() < takeover_deadline):
+            time.sleep(0.1)
+        takeover_s = time.monotonic() - t_kill
+        assert standby.role() == "primary", (
+            f"standby never took over; log:\n{standby.log_tail()}")
+        print(f"[fleet_smoke] router kill -9: {answered}/"
+              f"{N_STATELESS} stateless answered, takeover in "
+              f"{takeover_s:.1f}s", flush=True)
+        record["router_kill"] = {
+            "stateless_sent": N_STATELESS,
+            "stateless_answered": answered,
+            "takeover_s": round(takeover_s, 2)}
+        for r in replicas:
+            r.terminate()
+
+        # ---- leg 10: autoscale up under load, drain down -------------
+        launcher = LocalProcessLauncher(
+            serve_argv_template(
+                f"python -m raft_stereo_tpu.cli.serve "
+                f"--restore_ckpt {ckpt} --host 127.0.0.1 "
+                f"--port {{port}} --tiers {TIERS} "
+                f"--default_tier quality --valid_iters {ITERS} "
+                f"--batch_sizes {BATCH_SIZES} --max_batch 2 "
+                f"--max_queue 4 --sessions --session_ttl_s 600 "
+                f"--warmup_shape {HW[0]}x{HW[1]} "
+                f"--executable_cache_dir {store} "
+                f"--drain_timeout_s 60"),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            log_dir=workdir)
+        base_url = launcher.launch("base0")
+        router_c = FleetRouter(
+            {"base0": base_url},
+            RouterConfig(health_poll_s=0.2, health_timeout_s=2.0,
+                         fail_after=3, request_timeout_s=300.0,
+                         fleet_brownout=False)).start()
+        scaler = Autoscaler(
+            router_c, launcher,
+            AutoscaleConfig(min_replicas=1, max_replicas=2,
+                            engage_fraction=0.25, engage_s=0.4,
+                            restore_fraction=0.12, restore_s=1.0,
+                            cooldown_s=1.0))
+        deadline = time.monotonic() + 180
+        while (router_c.fleet_status()["ready"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert router_c.fleet_status()["ready"] == 1
+
+        stop_load = threading.Event()
+        load_errors = []
+
+        def _hammer():
+            while not stop_load.is_set():
+                try:
+                    router_c.forward_stateless(
+                        "POST", "/v1/disparity", payload,
+                        [("Content-Type", "application/x-npz")])
+                except Exception as e:  # noqa: BLE001 — shed = fine
+                    load_errors.append(type(e).__name__)
+
+        threads = [threading.Thread(target=_hammer, daemon=True)
+                   for _ in range(8)]
+        t_load = time.monotonic()
+        for t in threads:
+            t.start()
+        scaled = None
+        while scaled != "up" and time.monotonic() - t_load < 60:
+            scaled = scaler.check()
+            time.sleep(0.1)
+        assert scaled == "up", (
+            "load step never engaged the autoscaler (pressure "
+            f"{router_c.fleet_pressure()})")
+        t_up = time.monotonic() - t_load
+        # the new replica boots WARM from the store and joins rotation
+        deadline = time.monotonic() + 180
+        while (router_c.fleet_status()["ready"] < 2
+               and time.monotonic() < deadline):
+            scaler.check()
+            time.sleep(0.2)
+        assert router_c.fleet_status()["ready"] == 2, \
+            "the scaled-up replica never joined rotation"
+        print(f"[fleet_smoke] autoscale UP in {t_up:.1f}s after load "
+              f"step; fleet at 2 replicas", flush=True)
+        # live streams, so scale-down has warmth to hand off (retry
+        # through the load: a 429 shed is a typed answer, not a frame)
+        scale_sids = [f"as-cam-{i}" for i in range(4)]
+        for sid in scale_sids:
+            ok, t0 = 0, time.monotonic()
+            while ok < 2 and time.monotonic() - t0 < 120:
+                status, _, _ = router_c.forward_session(
+                    sid, "POST", f"/v1/stream/{sid}?tier=quality",
+                    payload, [("Content-Type", "application/x-npz")])
+                if status == 200:
+                    ok += 1
+                else:
+                    time.sleep(0.1)
+            assert ok == 2, f"session {sid} never got 2 frames through"
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+        t_calm = time.monotonic()
+        action = None
+        while action != "down" and time.monotonic() - t_calm < 120:
+            action = scaler.check()
+            time.sleep(0.1)
+        assert action == "down", (
+            f"pressure drop never restored (pressure "
+            f"{router_c.fleet_pressure()})")
+        deadline = time.monotonic() + 180
+        while scaler.draining and time.monotonic() < deadline:
+            scaler.check()
+            time.sleep(0.2)
+        assert not scaler.draining, "drained replica never reaped"
+        assert len(router_c.replicas) == 1
+        # THE acceptance line: the scripted pressure drop produced
+        # zero typed session losses — scale-down drained, never killed
+        assert router_c.sessions_lost.value == 0, \
+            "autoscale scale-down must hand sessions off, not 410 them"
+        frames_after = 0
+        for sid in scale_sids:
+            status, headers, _ = router_c.forward_session(
+                sid, "POST", f"/v1/stream/{sid}?tier=quality",
+                payload, [("Content-Type", "application/x-npz")])
+            assert status == 200
+            frames_after += 1
+        print(f"[fleet_smoke] autoscale DOWN drained cleanly: 0 typed "
+              f"losses, {frames_after}/{len(scale_sids)} streams "
+              f"continued", flush=True)
+        record["autoscale"] = {
+            "scale_up_s": round(t_up, 1),
+            "scale_ups": scaler.scale_ups.value,
+            "scale_downs": scaler.scale_downs.value,
+            "typed_session_losses": router_c.sessions_lost.value,
+            "streams_continued": frames_after,
+            "load_shed_errors": len(load_errors)}
+        return record
+    finally:
+        if router_c is not None:
+            router_c.stop()
+        if launcher is not None:
+            launcher.stop_all()
+        for rt in routers:
+            print(f"---- {rt.name} log tail ----\n{rt.log_tail()}",
+                  file=sys.stderr)
+            rt.cleanup()
+        for r in replicas:
+            r.cleanup()
 
 
 def build_checkpoint_and_store(workdir: str) -> tuple:
@@ -453,6 +805,24 @@ def main() -> int:
             f"{drain_target.proc.returncode}")
         print("[fleet_smoke] graceful SIGTERM: 10/10 in-flight answered, "
               "readyz flipped 503, exit 0", flush=True)
+
+        # ---- 8-10. round-18 HA legs on a fresh fleet -----------------
+        rserver.shutdown()
+        rserver = None
+        router.stop()
+        router = None
+        ha_record = ha_phase(ckpt, store, workdir, payload, d_body)
+        ha_rec = bench_record({
+            "metric": "fleet_ha_zero_loss_operations",
+            "value": 1.0,
+            "unit": ("rolling restart 0x410 + router kill takeover + "
+                     f"autoscale drain-down ({HW[0]}x{HW[1]}, "
+                     f"iters={ITERS}, CPU)"),
+            "fleet_ha": ha_record,
+        })
+        print(json.dumps(ha_rec))
+        write_record(HA_OUT, ha_rec, indent=1)
+        print(f"fleet HA legs OK -> {HA_OUT}", flush=True)
 
         rec = bench_record({
             "metric": "fleet_smoke_stateless_survival",

@@ -99,14 +99,24 @@ class StubReplica:
     or blackholed on demand."""
 
     def __init__(self, name: str, ready: bool = True,
-                 queue_depth: int = 0, queue_limit: int = 64):
+                 queue_depth: int = 0, queue_limit: int = 64,
+                 xl=None):
         self.name = name
         self.ready = ready
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
         self.blackhole_health = False
+        self.xl = xl
+        # Graceful-drain scripting (round 18): ``draining`` flips
+        # healthz/readyz like a real SIGTERMed replica and the request
+        # path sheds the typed draining 503; ``handoff_manifest`` is
+        # what GET /admin/handoff serves (None -> 404, like an engine
+        # that has not published yet).
+        self.draining = False
+        self.handoff_manifest = None
         self.requests = []
         self.sessions = []
+        self.stream_headers = []
         self.brownout_levels = []
         outer = self
 
@@ -137,14 +147,22 @@ class StubReplica:
                     return
                 if self.path == "/healthz":
                     self._json(200, {
-                        "status": "ok", "ready": outer.ready,
+                        "status": ("draining" if outer.draining
+                                   else "ok"),
+                        "ready": outer.ready and not outer.draining,
                         "queue_depth": outer.queue_depth,
                         "queue_limit": outer.queue_limit,
                         "inflight": 0, "brownout_level": 0,
+                        "xl": outer.xl,
                         "sessions_active": len(set(outer.sessions))})
                 elif self.path == "/readyz":
-                    self._json(200 if outer.ready else 503,
-                               {"ready": outer.ready})
+                    up = outer.ready and not outer.draining
+                    self._json(200 if up else 503, {"ready": up})
+                elif self.path == "/admin/handoff":
+                    if outer.handoff_manifest is None:
+                        self._json(404, {"error": "no_handoff"})
+                    else:
+                        self._json(200, outer.handoff_manifest)
                 else:
                     self._json(404, {"error": "no route"})
 
@@ -157,16 +175,27 @@ class StubReplica:
                     outer.brownout_levels.append(
                         json.loads(body)["level"])
                     self._json(200, {"status": "ok"})
-                elif path.startswith("/v1/stream/"):
+                    return
+                if outer.draining and path.startswith("/v1/"):
+                    # The engine's typed draining shed (begin_shutdown
+                    # stopped admitting while the listener stays up).
+                    self._json(503, {"error": "overloaded",
+                                     "draining": True,
+                                     "retry_after_s": 5.0},
+                               extra=[("Retry-After", "5")])
+                    return
+                if path.startswith("/v1/stream/"):
                     sid = path[len("/v1/stream/"):]
                     outer.sessions.append(sid)
+                    outer.stream_headers.append(
+                        (sid, {k: v for k, v in self.headers.items()}))
+                    warm = (outer.sessions.count(sid) > 1
+                            or "X-Handoff-Artifact" in self.headers)
                     self._send(
                         200, b"frame:" + outer.name.encode() + body,
                         ctype="application/x-npy",
                         extra=[("X-Session-Id", sid),
-                               ("X-Warm",
-                                "1" if outer.sessions.count(sid) > 1
-                                else "0")])
+                               ("X-Warm", "1" if warm else "0")])
                 elif path == "/v1/disparity":
                     self._send(
                         200, b"disp:" + outer.name.encode() + body,
@@ -397,6 +426,520 @@ def test_router_brownout_propagates_fleet_wide():
             s.kill()
 
 
+# ------------------------------------------------- drain handoff (round 18)
+def _route_sessions(router, stubs, n=12):
+    """Open n sessions through the router; returns {sid: owner_name}."""
+    owner = {}
+    by_name = {s.name: s for s in stubs}
+    for i in range(n):
+        sid = f"cam-{i}"
+        router.forward_session(sid, "POST", f"/v1/stream/{sid}", b"f", [])
+        owner[sid] = next(name for name, s in by_name.items()
+                          if sid in s.sessions)
+    return owner
+
+
+def test_drain_handoff_remaps_sessions_zero_410(fleet3):
+    """The round-18 acceptance shape at routing level: a replica that
+    DRAINS (instead of dying) hands its sessions to survivors — zero
+    SessionLost, every inherited frame tagged with the handoff
+    artifact, and the tag consumed after the first 200."""
+    stubs, router = fleet3
+    owner = _route_sessions(router, stubs)
+    victim = next(s for s in stubs
+                  if any(o == s.name for o in owner.values()))
+    moved = [sid for sid, o in owner.items() if o == victim.name]
+    kept = [sid for sid, o in owner.items() if o != victim.name]
+    victim.draining = True
+    victim.handoff_manifest = {"artifact": "abc123", "sessions": moved,
+                               "count": len(moved)}
+    router.check_replicas()      # drain observed + manifest fetched
+    st = router.fleet_status()
+    assert st["ready"] == 2
+    assert st["sessions_pending_loss"] == 0, \
+        "a planned drain must not type its sessions lost"
+    assert st["sessions_pending_handoff"] == len(moved)
+    assert router.sessions_lost.value == 0
+    # Every moved session's next frame: 200 on a survivor, tagged.
+    for sid in moved:
+        status, headers, body = router.forward_session(
+            sid, "POST", f"/v1/stream/{sid}", b"f", [])
+        assert status == 200
+        assert not body.startswith(b"frame:" + victim.name.encode())
+        tagged = [h for s2, h in
+                  [e for st2 in stubs for e in st2.stream_headers]
+                  if s2 == sid and "X-Handoff-Artifact" in h]
+        assert tagged and tagged[-1]["X-Handoff-Artifact"] == "abc123"
+    assert router.fleet_status()["sessions_pending_handoff"] == 0, \
+        "the handoff tag is consumed by the first successful frame"
+    # Second frame: no tag (the survivor owns the live state now).
+    for sid in moved[:2]:
+        status, _, _ = router.forward_session(
+            sid, "POST", f"/v1/stream/{sid}", b"f", [])
+        assert status == 200
+    # Survivor-owned sessions never noticed.
+    for sid in kept:
+        status, _, _ = router.forward_session(
+            sid, "POST", f"/v1/stream/{sid}", b"f", [])
+        assert status == 200
+    assert router.sessions_lost.value == 0
+    assert router.handoff_sessions.value == len(moved)
+
+
+def test_drain_503_race_rerouted_inline(fleet3):
+    """A frame that reaches a draining replica BEFORE the router's next
+    probe gets the typed draining 503 — the router must treat that as
+    the drain signal, fetch the manifest, and retry the frame once on
+    the inheriting replica.  Zero client-visible failures."""
+    stubs, router = fleet3
+    owner = _route_sessions(router, stubs, n=8)
+    victim = next(s for s in stubs
+                  if any(o == s.name for o in owner.values()))
+    moved = [sid for sid, o in owner.items() if o == victim.name]
+    # Drain flips WITHOUT a probe pass: the router still routes there.
+    victim.draining = True
+    victim.handoff_manifest = {"artifact": "race-key",
+                               "sessions": moved, "count": len(moved)}
+    sid = moved[0]
+    status, headers, body = router.forward_session(
+        sid, "POST", f"/v1/stream/{sid}", b"f", [])
+    assert status == 200, "the race must be absorbed, not surfaced"
+    assert not body.startswith(b"frame:" + victim.name.encode())
+    assert router.sessions_lost.value == 0
+    assert victim.name not in router.ring.members
+
+
+def test_drain_without_manifest_falls_back_to_typed_loss(fleet3):
+    """A drain that never publishes (crash mid-drain, pre-r18 replica)
+    keeps the r16 contract: when the process goes away its sessions
+    fail typed, exactly once."""
+    stubs, router = fleet3
+    owner = _route_sessions(router, stubs, n=8)
+    victim = next(s for s in stubs
+                  if any(o == s.name for o in owner.values()))
+    moved = [sid for sid, o in owner.items() if o == victim.name]
+    victim.draining = True       # manifest stays 404
+    router.check_replicas()
+    assert router.fleet_status()["sessions_pending_loss"] == 0
+    victim.kill()                # dies before ever publishing
+    router.check_replicas()
+    router.check_replicas()
+    assert router.fleet_status()["sessions_pending_loss"] == len(moved)
+    with pytest.raises(SessionLost):
+        router.forward_session(moved[0], "POST",
+                               f"/v1/stream/{moved[0]}", b"f", [])
+    status, _, _ = router.forward_session(       # fire-once: reseeds
+        moved[0], "POST", f"/v1/stream/{moved[0]}", b"f", [])
+    assert status == 200
+
+
+def test_lost_ledger_bounded_by_cap_and_gauge():
+    """Satellite: the lost-session ledger is capacity-capped like the
+    SessionStore tombstones, with fleet_lost_ledger_size live."""
+    stubs = [StubReplica(f"s{i}") for i in range(2)]
+    router = FleetRouter(
+        {s.name: s.url for s in stubs},
+        RouterConfig(health_timeout_s=2.0, fail_after=1,
+                     request_timeout_s=5.0, fleet_brownout=False,
+                     session_lost_cap=5))
+    try:
+        router.check_replicas()
+        owner = _route_sessions(router, stubs, n=20)
+        victim = next(s for s in stubs
+                      if sum(1 for o in owner.values()
+                             if o == s.name) > 5)
+        n_owned = sum(1 for o in owner.values() if o == victim.name)
+        victim.kill()
+        router.check_replicas()
+        st = router.fleet_status()
+        assert n_owned > 5
+        assert st["sessions_pending_loss"] == 5, \
+            "the cap must forget the oldest owed 410s"
+        assert router.lost_ledger_size.value == 5
+        # firing one decrements the gauge
+        fired = [sid for sid, o in owner.items()
+                 if o == victim.name][-1]
+        with pytest.raises(SessionLost):
+            router.forward_session(fired, "POST",
+                                   f"/v1/stream/{fired}", b"f", [])
+        assert router.lost_ledger_size.value == 4
+    finally:
+        for s in stubs:
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+# --------------------------------------------------- xl-capability routing
+def test_xl_routing_heterogeneous_fleet():
+    """``?tier=xl`` requests land only on replicas advertising the mesh
+    tier; plain requests still balance over everyone; a fleet whose xl
+    replicas all left rotation answers the typed xl_unavailable."""
+    from raft_stereo_tpu.serving.fleet import XlUnavailable
+
+    xl_topo = {"mesh": "rows=4", "label": "rows4", "groups": 1,
+               "devices_per_group": 4, "threshold_pixels": 2_000_000,
+               "batch_sizes": [1]}
+    stubs = [StubReplica("plain0"), StubReplica("plain1"),
+             StubReplica("big0", xl=xl_topo)]
+    router = FleetRouter(
+        {s.name: s.url for s in stubs},
+        RouterConfig(health_timeout_s=2.0, fail_after=1,
+                     request_timeout_s=5.0, fleet_brownout=False))
+    try:
+        router.check_replicas()
+        for _ in range(6):
+            status, _, body = router.forward_stateless(
+                "POST", "/v1/disparity?tier=xl", b"big", [])
+            assert status == 200 and body.startswith(b"disp:big0"), \
+                "xl requests must route to the xl-capable replica"
+        # the X-Tier header spelling routes identically
+        status, _, body = router.forward_stateless(
+            "POST", "/v1/disparity", b"big", [("X-Tier", "xl")])
+        assert body.startswith(b"disp:big0")
+        # non-xl traffic is unconstrained
+        hit = set()
+        for _ in range(12):
+            _, _, body = router.forward_stateless(
+                "POST", "/v1/disparity", b"x", [])
+            hit.add(body.split(b":")[1][:6])
+        assert len(hit) > 1
+        # xl replica leaves rotation -> typed 503 with the counts
+        stubs[2].kill()
+        router.check_replicas()
+        with pytest.raises(XlUnavailable) as e:
+            router.forward_stateless("POST", "/v1/disparity?tier=xl",
+                                     b"big", [])
+        assert e.value.capable_ready == 0
+        assert router.xl_unroutable.value >= 1
+        # plain traffic still flows
+        status, _, _ = router.forward_stateless("POST", "/v1/disparity",
+                                                b"x", [])
+        assert status == 200
+    finally:
+        for s in stubs:
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+def test_xl_unavailable_typed_over_http(fleet3):
+    stubs, router = fleet3          # nobody advertises xl
+    server = RouterHTTPServer(router, port=0).start()
+    try:
+        status, headers, body = _post(
+            f"{server.url}/v1/disparity?tier=xl", b"big")
+        assert status == 503
+        err = json.loads(body)
+        assert err["error"] == "xl_unavailable"
+        assert err["capable_replicas"] == 0
+        assert "Retry-After" in headers
+        assert 0.5 <= err["retry_after_s"] <= 1.5
+    finally:
+        server.shutdown()
+
+
+# --------------------------------------------------------- HA ledger + pair
+def test_ledger_fencing_rejects_stale_writer(tmp_path):
+    from raft_stereo_tpu.serving.fleet import FleetLedger
+
+    a = FleetLedger(str(tmp_path), "rt-a")
+    b = FleetLedger(str(tmp_path), "rt-b")
+    assert a.acquire() == 1
+    assert a.append("lost", sids=["s1"], replica="r0")
+    assert b.acquire() == 2, "takeover bumps the fencing epoch"
+    assert b.append("fired", sid="s1")
+    # the stale writer's appends are REJECTED, not interleaved
+    assert a.append("fired", sid="s2") is False
+    assert a.rejected_appends == 1
+    assert not a.active, "a fenced writer knows it lost the lease"
+    kinds = [r["kind"] for r in b.replay()]
+    assert kinds == ["lost", "fired"], \
+        "the stale append must not have reached the ledger"
+    # renew() on the fenced writer also reports the loss
+    assert a.renew() is False
+    assert b.renew() is True
+
+
+def test_ledger_replay_skips_torn_tail(tmp_path):
+    from raft_stereo_tpu.serving.fleet import FleetLedger
+
+    a = FleetLedger(str(tmp_path), "rt-a")
+    a.acquire()
+    a.append("lost", sids=["x"], replica="r0")
+    with open(a._ledger_path, "a") as f:
+        f.write('{"kind": "lost", "sids": ["torn...')   # torn tail
+    assert [r["kind"] for r in a.replay()] == ["lost"]
+
+
+def test_ledger_lease_staleness(tmp_path):
+    from raft_stereo_tpu.serving.fleet import FleetLedger
+
+    clock = FakeClock(t=100.0)
+    a = FleetLedger(str(tmp_path), "rt-a", clock=clock)
+    b = FleetLedger(str(tmp_path), "rt-b", clock=clock)
+    a.acquire()
+    assert not b.is_stale(3.0)
+    clock.t += 5.0
+    assert b.is_stale(3.0), "an unrenewed lease goes stale"
+    a.renew()
+    assert not b.is_stale(3.0)
+    assert not a.is_stale(3.0), "the holder never sees itself stale"
+
+
+def test_ha_takeover_never_double_fires_a_loss(tmp_path):
+    """The acceptance pin: a loss FIRED by the primary is never fired
+    again by the standby after takeover (the ledger's fired record
+    survives the router's death); a loss OWED but not yet delivered
+    re-arms and fires exactly once on the standby."""
+    stubs = [StubReplica(f"s{i}") for i in range(3)]
+    ha = str(tmp_path)
+    cfg = dict(health_timeout_s=2.0, fail_after=1,
+               request_timeout_s=5.0, fleet_brownout=False)
+    primary = FleetRouter({s.name: s.url for s in stubs},
+                          RouterConfig(ha_dir=ha, router_name="rt-a",
+                                       **cfg))
+    standby = None
+    try:
+        assert primary.active and primary.ledger.epoch == 1
+        primary.check_replicas()
+        owner = _route_sessions(primary, stubs, n=10)
+        victim = next(s for s in stubs
+                      if sum(1 for o in owner.values()
+                             if o == s.name) >= 2)
+        lost = [sid for sid, o in owner.items() if o == victim.name]
+        victim.kill()
+        primary.check_replicas()
+        # primary delivers ONE of the owed 410s, then "dies"
+        with pytest.raises(SessionLost):
+            primary.forward_session(lost[0], "POST",
+                                    f"/v1/stream/{lost[0]}", b"f", [])
+        standby = FleetRouter({s.name: s.url for s in stubs},
+                              RouterConfig(ha_dir=ha,
+                                           router_name="rt-b",
+                                           standby=True, **cfg))
+        assert not standby.active
+        standby.check_replicas()
+        standby.takeover()
+        assert standby.active and standby.ledger.epoch == 2
+        # the fired id must NOT fire again: it reseeds cold instead
+        status, _, _ = standby.forward_session(
+            lost[0], "POST", f"/v1/stream/{lost[0]}", b"f", [])
+        assert status == 200, \
+            "a 410 already delivered must never fire twice for one id"
+        # an owed-but-undelivered id fires exactly once on the standby
+        with pytest.raises(SessionLost):
+            standby.forward_session(lost[1], "POST",
+                                    f"/v1/stream/{lost[1]}", b"f", [])
+        status, _, _ = standby.forward_session(
+            lost[1], "POST", f"/v1/stream/{lost[1]}", b"f", [])
+        assert status == 200
+        # the fenced ex-primary can no longer append
+        assert primary._ledger_append("fired", sid="zzz") is False
+        assert not primary.active, "fencing demotes the stale primary"
+    finally:
+        primary.stop()
+        if standby is not None:
+            standby.stop()
+        for s in stubs:
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+def test_ha_standby_serves_while_passive(tmp_path):
+    """The standby forwards traffic the whole time (stateless balancing
+    and ring-sticky sessions need no shared state) — only ledger writes
+    wait for the lease."""
+    stubs = [StubReplica(f"s{i}") for i in range(2)]
+    cfg = dict(health_timeout_s=2.0, fail_after=1,
+               request_timeout_s=5.0, fleet_brownout=False)
+    primary = FleetRouter({s.name: s.url for s in stubs},
+                          RouterConfig(ha_dir=str(tmp_path),
+                                       router_name="rt-a", **cfg))
+    standby = FleetRouter({s.name: s.url for s in stubs},
+                          RouterConfig(ha_dir=str(tmp_path),
+                                       router_name="rt-b",
+                                       standby=True, **cfg))
+    try:
+        primary.check_replicas()
+        standby.check_replicas()
+        assert standby.fleet_status()["role"] == "standby"
+        status, _, _ = standby.forward_stateless(
+            "POST", "/v1/disparity", b"x", [])
+        assert status == 200
+        # both routers agree on session placement (deterministic ring)
+        for sid in ("cam-a", "cam-b", "cam-c"):
+            assert (primary.ring.lookup(sid)
+                    == standby.ring.lookup(sid))
+    finally:
+        primary.stop()
+        standby.stop()
+        for s in stubs:
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------- autoscaler
+class RecordingLauncher:
+    """Scripted ReplicaLauncher: launches are stub replicas, drains are
+    recorded and complete on demand — never a kill."""
+
+    def __init__(self):
+        self.stubs = {}
+        self.drained = []
+        self.killed = []
+        self.exited = {}
+
+    def launch(self, name):
+        stub = StubReplica(name)
+        self.stubs[name] = stub
+        return stub.url
+
+    def drain(self, name):
+        self.drained.append(name)
+        stub = self.stubs.get(name)
+        if stub is not None:
+            stub.draining = True
+            stub.handoff_manifest = {"artifact": None, "sessions": [],
+                                     "count": 0}
+
+    def finish_drain(self, name):
+        self.exited[name] = 0
+        stub = self.stubs.get(name)
+        if stub is not None:
+            stub.kill()
+
+    def poll(self, name):
+        return self.exited.get(name)
+
+    def destroy(self, name):
+        self.killed.append(name)
+        stub = self.stubs.pop(name, None)
+        if stub is not None:
+            try:
+                stub.kill()
+            except Exception:
+                pass
+
+    def cleanup(self):
+        for name in list(self.stubs):
+            self.destroy(name)
+
+
+def _autoscaler(router, launcher, clock, trace):
+    from raft_stereo_tpu.serving.fleet import AutoscaleConfig, Autoscaler
+
+    it = iter(trace)
+
+    def pressure():
+        try:
+            return next(it)
+        except StopIteration:
+            return trace[-1]
+
+    return Autoscaler(
+        router, launcher,
+        AutoscaleConfig(min_replicas=1, max_replicas=3,
+                        engage_fraction=0.6, engage_s=1.0,
+                        restore_fraction=0.15, restore_s=2.0,
+                        cooldown_s=0.5),
+        clock=clock, pressure_fn=pressure)
+
+
+def test_autoscaler_hysteresis_on_scripted_trace():
+    """Satellite: engage needs SUSTAINED pressure, the dead band holds
+    (no flapping), restore needs longer sustained calm, and scale-down
+    always DRAINS the launched replica."""
+    base = StubReplica("base0")
+    router = FleetRouter(
+        {"base0": base.url},
+        RouterConfig(health_timeout_s=2.0, fail_after=1,
+                     request_timeout_s=5.0, fleet_brownout=False))
+    launcher = RecordingLauncher()
+    clock = FakeClock(t=0.0)
+    # scripted pressure: spike (not sustained) -> calm -> sustained
+    # spike -> dead band -> sustained calm
+    trace = [0.9, 0.1,                 # blip: must NOT scale
+             0.9, 0.9, 0.9,           # sustained: scale up once
+             0.4, 0.4,                # dead band: hold
+             0.05, 0.05, 0.05, 0.05, 0.05, 0.05]   # calm: scale down
+    try:
+        router.check_replicas()
+        scaler = _autoscaler(router, launcher, clock, trace)
+        actions = []
+        for _ in range(len(trace)):
+            actions.append(scaler.check())
+            clock.t += 0.6
+        assert actions.count("up") == 1, f"flapped: {actions}"
+        assert actions.count("down") == 1, f"flapped: {actions}"
+        assert actions[0] is None and actions[1] is None, \
+            "a one-poll blip must not scale (engage_s hysteresis)"
+        up_i = actions.index("up")
+        down_i = actions.index("down")
+        assert up_i < down_i
+        assert launcher.drained == ["auto1"], \
+            "scale-down must DRAIN the launched replica"
+        assert launcher.killed == [], "scale-down must never kill"
+        assert "auto1" in router.replicas, \
+            "deregistration waits for the drain to finish"
+        # drain completes -> reaped on the next check
+        launcher.finish_drain("auto1")
+        scaler.check()
+        assert "auto1" not in router.replicas
+        assert scaler.draining == []
+        assert scaler.scale_ups.value == 1
+        assert scaler.scale_downs.value == 1
+    finally:
+        launcher.cleanup()
+        try:
+            base.kill()
+        except Exception:
+            pass
+
+
+def test_autoscaler_respects_bounds_and_cooldown():
+    base = StubReplica("base0")
+    router = FleetRouter(
+        {"base0": base.url},
+        RouterConfig(health_timeout_s=2.0, fail_after=1,
+                     request_timeout_s=5.0, fleet_brownout=False))
+    launcher = RecordingLauncher()
+    clock = FakeClock(t=0.0)
+    trace = [0.95] * 40
+    try:
+        router.check_replicas()
+        scaler = _autoscaler(router, launcher, clock, trace)
+        ups = 0
+        for _ in range(40):
+            if scaler.check() == "up":
+                ups += 1
+            clock.t += 0.4
+        assert ups == 2, "max_replicas=3 bounds growth to +2"
+        assert len(router.replicas) == 3
+        # endless calm drains only what the autoscaler launched (the
+        # base fleet stays; min_replicas is a floor, not a target)
+        scaler._pressure_fn = lambda: 0.0
+        downs = 0
+        for _ in range(50):
+            if scaler.check() == "down":
+                downs += 1
+            clock.t += 0.4
+        assert downs == 2, "launched replicas only; base fleet stays"
+        assert launcher.killed == []
+    finally:
+        launcher.cleanup()
+        try:
+            base.kill()
+        except Exception:
+            pass
+
+
 # ---------------------------------------------------- router HTTP surface
 def _get(url, timeout=5):
     req = urllib.request.Request(url)
@@ -467,8 +1010,13 @@ def test_router_http_surface_and_passthrough(fleet3):
         assert json.loads(body)["error"] == "session_lost"
         status, headers, body = _post(f"{base}/v1/disparity", b"x")
         assert status == 503
-        assert json.loads(body)["error"] == "no_replicas_ready"
-        assert headers["Retry-After"] == "1"
+        err = json.loads(body)
+        assert err["error"] == "no_replicas_ready"
+        # r13 overload contract + jitter (round 18): the body carries a
+        # precise jittered retry_after_s, the header its integer
+        # ceiling — synchronized clients must not retry in lockstep.
+        assert 0.5 <= err["retry_after_s"] <= 1.5
+        assert headers["Retry-After"] in ("1", "2")
     finally:
         server.shutdown()
 

@@ -696,3 +696,204 @@ def test_poisoned_session_frame_releases_lock_and_next_frame_cold(
             "chaining across the gap)"
         f3 = svc.infer_session("s", left, right, timeout=300)
         assert f3.warm                           # chain re-established
+
+
+# ------------------------------------------------- session handoff (round 18)
+def _filled_store(n=6, with_ctx=True):
+    from raft_stereo_tpu.serving.sessions import SessionStore
+
+    store = SessionStore()
+    rng = np.random.default_rng(7)
+    for i in range(n):
+        sess, _ = store.get_or_create(f"cam-{i}")
+        sess.note_result(
+            flow_low=rng.standard_normal((8, 12)).astype(np.float32),
+            thumb=rng.standard_normal((3, 4)).astype(np.float32),
+            bucket=(32, 48), raw_shape=(30, 45),
+            warm=(i % 2 == 0), iters_used=3 + i)
+        if with_ctx and i % 2 == 0:
+            sess.ctx = (rng.standard_normal((2, 2)).astype(np.float32),
+                        (rng.standard_normal((4,)).astype(np.float32),
+                         None))
+    return store
+
+
+def test_handoff_export_import_round_trip():
+    """Round-trip property: every field that decides the next frame's
+    warmth — flow, thumbnail, bucket, raw shape, counters, ctx —
+    survives export()/import_() exactly."""
+    from raft_stereo_tpu.serving.sessions import SessionStore
+
+    src = _filled_store()
+    blob = src.export()
+    dst = SessionStore()
+    imported, skipped = dst.import_(blob)
+    assert (imported, skipped) == (6, 0)
+    for i in range(6):
+        a = src.get(f"cam-{i}")
+        b = dst.get(f"cam-{i}")
+        assert np.array_equal(a.flow_low, b.flow_low)
+        assert np.array_equal(a.thumb, b.thumb)
+        assert a.bucket == b.bucket and a.raw_shape == b.raw_shape
+        for field in ("frame_index", "warm_frames", "cold_frames",
+                      "scene_cuts", "iters_used_sum",
+                      "iters_used_frames"):
+            assert getattr(a, field) == getattr(b, field), field
+        if a.ctx is None:
+            assert b.ctx is None
+        else:
+            assert np.array_equal(a.ctx[0], b.ctx[0])
+            assert np.array_equal(a.ctx[1][0], b.ctx[1][0])
+            assert b.ctx[1][1] is None
+
+
+def test_handoff_corrupt_entry_degrades_to_cold_never_crashes():
+    """Satellite property sweep: flip any byte of the blob — the
+    importer never raises, and at worst the touched session is skipped
+    (cold start) while the rest import intact."""
+    from raft_stereo_tpu.serving.sessions import (SessionStore,
+                                                  parse_handoff_blob)
+
+    src = _filled_store(n=4, with_ctx=False)
+    blob = src.export()
+    rng = np.random.default_rng(11)
+    for _ in range(40):
+        bad = bytearray(blob)
+        pos = int(rng.integers(0, len(bad)))
+        bad[pos] ^= 0xFF
+        records, skipped = parse_handoff_blob(bytes(bad))  # never raises
+        assert len(records) + skipped <= 4
+        dst = SessionStore()
+        imported, _ = dst.import_(bytes(bad))
+        assert imported == len(records)
+    # truncation at every decile: never a crash
+    for frac in range(0, 10):
+        cut = blob[: len(blob) * frac // 10]
+        records, _ = parse_handoff_blob(bytes(cut))
+        assert isinstance(records, dict)
+
+
+def test_handoff_import_respects_live_and_tombstoned_ids():
+    from raft_stereo_tpu.serving.sessions import SessionStore
+
+    src = _filled_store(n=3, with_ctx=False)
+    blob = src.export()
+    dst = SessionStore()
+    live, _ = dst.get_or_create("cam-0")        # live id: import skips
+    live.frame_index = 99
+    dst.close(dst.get_or_create("cam-1")[0].session_id)   # tombstoned
+    imported, skipped = dst.import_(blob)
+    assert imported == 1 and skipped == 2
+    assert dst.get("cam-0").frame_index == 99, \
+        "an import must never clobber a live stream"
+    with pytest.raises(SessionExpired):
+        dst.get("cam-1")
+
+
+def test_engine_handoff_state_numerically_identical(tiny_model):
+    """ISSUE acceptance: a handed-off session's next warm dispatch is
+    numerically identical to the dispatch a never-drained engine would
+    have produced — the handoff moves state, it does not perturb it."""
+    import tempfile
+
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with tempfile.TemporaryDirectory() as store_dir:
+        serve_cfg = ServeConfig(max_batch=1, batch_sizes=(1,), iters=2,
+                                sessions=True,
+                                executable_cache_dir=store_dir)
+        ref = StereoService(cfg, variables, serve_cfg)   # never drained
+        a = StereoService(cfg, variables, serve_cfg)     # drains
+        b = StereoService(cfg, variables, serve_cfg)     # inherits
+        try:
+            for svc in (ref, a):
+                f1 = svc.infer_session("cam", left, right, timeout=300)
+                f2 = svc.infer_session("cam", left, right, timeout=300)
+                assert not f1.warm and f2.warm
+            a.begin_shutdown()
+            manifest = a.publish_handoff()
+            assert manifest["count"] == 1 and manifest["artifact"]
+            f3_ref = ref.infer_session("cam", left, right, timeout=300)
+            f3_b = b.infer_session("cam", left, right, timeout=300,
+                                   handoff_key=manifest["artifact"])
+            assert f3_b.warm, \
+                "the first post-handoff frame must dispatch WARM"
+            assert f3_b.frame_index == 2
+            assert np.array_equal(f3_b.flow, f3_ref.flow), \
+                "handoff-imported state must be numerically identical"
+            assert b.metrics.sessions_adopted.value == 1
+            assert a.metrics.sessions_exported.value == 1
+            # chain continues warm on the inheritor
+            f4 = b.infer_session("cam", left, right, timeout=300)
+            assert f4.warm and f4.frame_index == 3
+            # a MISSING artifact key degrades to a plain cold start
+            miss = b.infer_session("other", left, right, timeout=300,
+                                   handoff_key="deadbeef" * 8)
+            assert not miss.warm and miss.frame_index == 0, \
+                "a missing handoff artifact degrades to a cold start"
+        finally:
+            ref.close()
+            a.close()
+            b.close()
+
+
+@pytest.mark.slow
+def test_http_stream_handoff_header(tiny_model):
+    """The HTTP leg of the handoff: GET /admin/handoff serves the
+    manifest after a drain published it, and X-Handoff-Artifact on the
+    inheriting replica's first frame imports the state (X-Warm: 1).
+    Slow tier: the engine-level numeric-identity test above pins the
+    same import path; this adds only the header plumbing, which the
+    fleet smoke also exercises end-to-end on every CI run."""
+    import json as json_mod
+    import tempfile
+    import urllib.request
+
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with tempfile.TemporaryDirectory() as store_dir:
+        serve_cfg = ServeConfig(max_batch=1, batch_sizes=(1,), iters=2,
+                                sessions=True,
+                                executable_cache_dir=store_dir)
+        a = StereoService(cfg, variables, serve_cfg)
+        b = StereoService(cfg, variables, serve_cfg)
+        sa = StereoHTTPServer(a, port=0).start()
+        sb = StereoHTTPServer(b, port=0).start()
+        try:
+            # no manifest yet -> typed 404
+            try:
+                urllib.request.urlopen(f"{sa.url}/admin/handoff",
+                                       timeout=10)
+                raise AssertionError("expected 404 before publish")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            _post_stream(sa.url, "cam", left, right).read()
+            with _post_stream(sa.url, "cam", left, right) as resp:
+                assert resp.headers["X-Warm"] == "1"
+            a.begin_shutdown()
+            a.publish_handoff()
+            with urllib.request.urlopen(f"{sa.url}/admin/handoff",
+                                        timeout=10) as resp:
+                manifest = json_mod.load(resp)
+            assert manifest["sessions"] == ["cam"]
+            buf = io.BytesIO()
+            np.savez(buf, left=left, right=right)
+            req = urllib.request.Request(
+                f"{sb.url}/v1/stream/cam", data=buf.getvalue(),
+                method="POST",
+                headers={"Content-Type": "application/x-npz",
+                         "X-Handoff-Artifact": manifest["artifact"]})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert resp.headers["X-Warm"] == "1", \
+                    "the inherited frame must dispatch warm over HTTP"
+                assert resp.headers["X-Frame-Index"] == "2"
+        finally:
+            sa.shutdown()
+            sb.shutdown()
+            a.close()
+            b.close()
