@@ -42,7 +42,7 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))
 
 OUT = "BENCH_SERVE_r15.json"
 BASELINE = "BENCH_SERVE_r06.json"
-XL_OUT = "BENCH_XL_r17.json"
+XL_OUT = "BENCH_XL_r19.json"
 
 
 def build_model(on_cpu: bool):
@@ -418,6 +418,49 @@ def xl_sweep_main():
                       f"{row['per_device_hbm_mib']} MiB is not below the "
                       f"solo figure {solo_row['per_device_hbm_mib']} MiB",
                       flush=True)
+
+    # XL batch>1 ladder row (r17 follow-up): the batch-2/4 xl
+    # executables were compiled but never exercised by any bench — a
+    # staged 4-burst through one mesh engine forces the pop to take the
+    # batch-4 rung (and a second burst times it warm), proving the
+    # ladder dispatches and recording its per-device HBM next to b1's.
+    burst_mesh = meshes[0]
+    with ServingEngine(cfg, variables, ServeConfig(
+            iters=iters, cost_telemetry=True, xl_mesh=burst_mesh,
+            xl_threshold_pixels=1000,
+            xl_batch_sizes=(1, 2, 4))) as eng:
+        if eng.xl_enabled:
+            eng.infer(left, right, timeout=3600)      # warm batch-1
+            for timed in (False, True):
+                eng.queue.pause()                     # stage exact depth
+                futs = [eng.submit(left, right) for _ in range(4)]
+                t0 = time.perf_counter()
+                eng.queue.resume()
+                for f in futs:
+                    f.result(timeout=3600)
+                burst_wall = time.perf_counter() - t0
+            rec4 = eng.compiled_cost(eng.bucket_for(left.shape), 4,
+                                     family="xl")
+            row = {"row": f"xl {burst_mesh} batch ladder",
+                   "bucket": f"{hw[0]}x{hw[1]}", "iters": iters,
+                   "burst": 4,
+                   "dispatches_b4": eng.metrics.dispatches_at(4),
+                   "dispatches_b2": eng.metrics.dispatches_at(2),
+                   "dispatches_b1": eng.metrics.dispatches_at(1),
+                   "ms_per_image_burst": round(burst_wall / 4 * 1e3, 1),
+                   "b4_per_device_hbm_mib": (
+                       round(rec4.hbm_bytes / 2 ** 20, 1)
+                       if rec4 is not None and rec4.hbm_bytes
+                       else None)}
+            rows_out.append(row)
+            print(json.dumps(row), flush=True)
+            if eng.metrics.dispatches_at(4) < 1:
+                print(f"WARNING: xl {burst_mesh} burst of 4 never "
+                      f"dispatched the batch-4 rung", flush=True)
+        else:
+            print(json.dumps({"row": f"xl {burst_mesh} batch ladder",
+                              "skipped": "not enough devices"}),
+                  flush=True)
 
     # Halo-tiled fallback row: the same pair through ordinary bucket
     # dispatches (beyond-mesh path), seam error measured.

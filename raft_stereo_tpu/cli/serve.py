@@ -89,6 +89,9 @@ def build_service(args):
         scene_cut_threshold=args.scene_cut_threshold,
         session_ctx_cache=args.session_ctx_cache,
         ctx_cache_threshold=args.ctx_cache_threshold,
+        session_hidden=args.session_hidden,
+        edf_scheduler=args.edf_scheduler,
+        edf_max_slack_ms=args.edf_max_slack_ms,
         quant_scales_path=args.quant_scales,
         xl_mesh=args.xl_mesh,
         xl_workers=args.xl_workers,
@@ -465,6 +468,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "or below which a warm frame may reuse the "
                         "cached context — the static-scene gate, far "
                         "below the scene-cut threshold by design")
+    p.add_argument("--session_hidden", action="store_true",
+                   help="hidden-state warm start (needs --sessions): "
+                        "carry the multi-level GRU hidden state frame "
+                        "to frame alongside the disparity, so warm "
+                        "frames resume the GRU's own trajectory — the "
+                        "warm-h executable families; lets the "
+                        "convergence gate chain stably at tighter "
+                        "thresholds than the flow-only warm start "
+                        "(STREAM_r19.json)")
+    p.add_argument("--edf_scheduler", action="store_true",
+                   help="deadline-aware EDF pop policy: frames carrying "
+                        "a per-frame deadline (X-Deadline-Ms) are "
+                        "ordered earliest-deadline-first and an idle "
+                        "worker waits a bounded slack to coalesce "
+                        "concurrent streams' frames into one batch-N "
+                        "dispatch.  Deadline-less requests keep the "
+                        "immediate-pop behavior; off = the exact "
+                        "continuous-batching scheduler")
+    p.add_argument("--edf_max_slack_ms", type=float, default=50.0,
+                   help="EDF coalescing bound: never hold a frame more "
+                        "than this past its arrival (the nearest "
+                        "deadline minus the bucket's measured dispatch "
+                        "latency is always the harder bound)")
     # XL tier + tiling fallback (docs/architecture.md §Serving, "XL tier").
     p.add_argument("--xl_mesh", default=None,
                    help="serve an XL tier whose bucket executables are "

@@ -72,7 +72,9 @@ def early_exit_enabled(config: RaftStereoConfig) -> bool:
 def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
                  donate_images: bool = True, warm_start: bool = False,
                  return_state: bool = False,
-                 ctx: Optional[str] = None):
+                 ctx: Optional[str] = None,
+                 hidden_init: bool = False,
+                 return_hidden: bool = False):
     """The one jitted inference program both the solo runner and the
     serving engine compile, per (padded shape, batch): cast -> forward ->
     optional half-precision fetch cast.  Built here so the two paths share
@@ -119,6 +121,25 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
       scene unchanged.  The bundle is a pytree and rides jit like any
       other argument; it is never donated (the session re-feeds it
       frame after frame from its host copy).
+    * ``return_hidden=True`` (streaming only, implies the streaming
+      signature) — the program additionally returns the FINAL per-level
+      GRU hidden states (a tuple of (N, Hp/(f·2^l), Wp/(f·2^l), C_l)
+      arrays in the model's compute dtype): the second half of the
+      temporal state, which ``flow_init`` alone leaves cold (round-19
+      hidden-state warm start).  Appended after ``iters_used`` and
+      before the ctx bundle.
+    * ``hidden_init=True`` (implies ``return_hidden``'s signature use —
+      warm-h programs both consume and return the tree) — the program
+      takes the previous frame's hidden tree as an extra traced input
+      (after ``flow_init``, before any ctx bundle) and the refinement
+      loop resumes from those EVOLVED states instead of the fresh
+      ``tanh`` init.  Donated alongside the images when
+      ``donate_images`` — same shapes/dtypes as the returned tree, so
+      XLA can alias the state round-trip.
+
+    Traced-input order (streaming): ``(variables, images1, images2
+    [, flow_init][, hidden][, ctx])``; return order: ``(flow_up,
+    flow_low[, iters_used][, hidden][, ctx])``.
 
     With ``model.config.quant == "int8"`` every variant expects the
     QUANTIZED variable tree (quant/core.quantize_variables) and
@@ -135,7 +156,8 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
             return dequantize_variables(variables)
         return variables
 
-    if warm_start or return_state or ctx is not None:
+    if (warm_start or return_state or ctx is not None
+            or hidden_init or return_hidden):
         if ctx not in (None, "save", "reuse"):
             raise ValueError(f"ctx={ctx!r}: use None, 'save', or 'reuse'")
 
@@ -147,27 +169,46 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
             if warm_start:
                 flow_init = extra[pos].astype(jnp.float32)
                 pos += 1
+            hidden = None
+            if hidden_init:
+                hidden = extra[pos]
+                pos += 1
             ctx_init = extra[pos] if ctx == "reuse" else None
             out = model.apply(
                 variables if not quantized else prepare(variables),
                 img1, img2, iters=iters, test_mode=True,
                 flow_init=flow_init, ctx_init=ctx_init,
-                return_ctx=(ctx == "save"))
+                return_ctx=(ctx == "save"),
+                hidden_init=hidden, return_hidden=return_hidden)
             flow_up = out[1]
             if fetch_dtype is not None:
                 flow_up = flow_up.astype(fetch_dtype)
             # flow_low stays float32 regardless of fetch_dtype: it is the
             # next frame's init, and a half-precision state would compound
-            # rounding frame over frame.
+            # rounding frame over frame.  (The hidden tree rides in the
+            # model's own compute dtype — it re-enters the SAME compute
+            # path, so there is no precision boundary to cross.)
             ret = (flow_up, out[0].astype(jnp.float32))
+            src = 2
             if adaptive:
-                ret = ret + (out[2],)
+                ret = ret + (out[src],)
+                src += 1
+            if return_hidden:
+                ret = ret + (out[src],)
+                src += 1
             if ctx == "save":
-                ret = ret + (out[-1],)
+                ret = ret + (out[src],)
             return ret
 
-        donate = ((1, 2, 3) if warm_start else (1, 2)) \
-            if donate_images else ()
+        donate: Tuple[int, ...] = ()
+        if donate_images:
+            donate = (1, 2)
+            pos = 3
+            if warm_start:
+                donate = donate + (pos,)
+                pos += 1
+            if hidden_init:
+                donate = donate + (pos,)
         return jax.jit(fwd_stream, donate_argnums=donate)
 
     def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
@@ -307,6 +348,10 @@ class StreamFrame:
     seconds: float               # same stop clock as __call__ (result fetch)
     iters_used: Optional[int]    # GRU trip count (None without early exit)
     warm: bool                   # True when prev_flow_low seeded the GRU
+    # Final per-level GRU hidden states (tuple of (h_l, w_l, C_l) host
+    # arrays, batch axis stripped) — the next frame's ``prev_hidden``.
+    # None unless the caller asked for it (``carry_hidden``).
+    hidden: Optional[object] = None
 
     @property
     def disparity(self) -> np.ndarray:
@@ -585,11 +630,15 @@ class InferenceRunner:
         return np.ascontiguousarray(flows), elapsed
 
     # ------------------------------------------------------------- streaming
-    def _stream_forward_for(self, padded_hw: Tuple[int, int], warm: bool):
+    def _stream_forward_for(self, padded_hw: Tuple[int, int], warm: bool,
+                            hidden_in: bool = False,
+                            hidden_out: bool = False):
         """The state-returning (and, warm, state-consuming) program for
         one padded shape — the sequence/demo twin of the serving engine's
-        warm bucket executables.  Bounded like ``_compiled``."""
-        key = (padded_hw, warm)
+        warm bucket executables.  Bounded like ``_compiled``.  The
+        hidden flags select the round-19 warm-h program variants; both
+        False keeps the exact round-14 programs (and cache keys)."""
+        key = (padded_hw, warm, hidden_in, hidden_out)
         if key not in self._stream_compiled:
             while len(self._stream_compiled) >= self.max_cached_shapes:
                 self._stream_compiled.pop(
@@ -597,14 +646,16 @@ class InferenceRunner:
             self._stream_compiled[key] = make_forward(
                 self.model, self.iters, self.fetch_dtype,
                 donate_images=self.donate_images,
-                warm_start=warm, return_state=True)
+                warm_start=warm, return_state=True,
+                hidden_init=hidden_in, return_hidden=hidden_out)
         else:  # LRU refresh
             self._stream_compiled[key] = self._stream_compiled.pop(key)
         return self._stream_compiled[key]
 
     def run_stream(self, image1: np.ndarray, image2: np.ndarray,
-                   prev_flow_low: Optional[np.ndarray] = None
-                   ) -> StreamFrame:
+                   prev_flow_low: Optional[np.ndarray] = None,
+                   prev_hidden: Optional[object] = None,
+                   carry_hidden: bool = False) -> StreamFrame:
         """One frame of a temporally ordered sequence: like ``__call__``
         but the GRU warm-starts from ``prev_flow_low`` (the previous
         frame's ``StreamFrame.flow_low``) and the returned frame carries
@@ -617,7 +668,16 @@ class InferenceRunner:
         the FPS win bench_stream.py measures.  A ``prev_flow_low`` whose
         shape does not match this frame's padded low-res grid raises:
         resolution changes are a caller-visible stream break, not
-        something to resample over silently."""
+        something to resample over silently.
+
+        ``carry_hidden=True`` asks for the frame's final GRU hidden
+        states on the returned ``StreamFrame.hidden``; passing them back
+        as ``prev_hidden`` (together with ``prev_flow_low``) runs the
+        warm-h program — the GRU resumes its own trajectory instead of
+        re-deriving it from the context encoder every frame (round 19;
+        requires ``prev_flow_low``, the hidden state is meaningless
+        without the disparity it evolved against).  Both default off:
+        the round-14 programs and their cache keys are untouched."""
         assert image1.ndim == 3 and image1.shape == image2.shape
         t0 = time.perf_counter()
         padder = InputPadder((1,) + image1.shape, divis_by=self.divis_by)
@@ -628,24 +688,40 @@ class InferenceRunner:
         f = self.effective_config.downsample_factor
         low_hw = (p1.shape[0] // f, p1.shape[1] // f)
         warm = prev_flow_low is not None
+        if prev_hidden is not None and not warm:
+            raise ValueError("prev_hidden needs prev_flow_low: the "
+                             "hidden state is meaningless without the "
+                             "disparity it evolved against")
         if warm and tuple(prev_flow_low.shape) != low_hw:
             raise ValueError(
                 f"prev_flow_low shape {prev_flow_low.shape} does not "
                 f"match this frame's padded low-res grid {low_hw} — the "
                 f"stream changed resolution; restart with "
                 f"prev_flow_low=None")
-        fwd = self._stream_forward_for(p1.shape[:2], warm)
+        hidden_in = prev_hidden is not None
+        hidden_out = carry_hidden or hidden_in
+        fwd = self._stream_forward_for(p1.shape[:2], warm,
+                                       hidden_in=hidden_in,
+                                       hidden_out=hidden_out)
         args = [self.variables, jnp.asarray(p1[None]), jnp.asarray(p2[None])]
         if warm:
             args.append(jnp.asarray(
                 np.ascontiguousarray(prev_flow_low, dtype=np.float32)[None]))
+        if hidden_in:
+            args.append(tuple(jnp.asarray(np.asarray(h)[None])
+                              for h in prev_hidden))
         out = fwd(*args)
         iters_used = None
+        pos = 2
         if self.early_exit:
-            flow_up, flow_low, iters_dev = out
-            iters_used = self._note_iters_used(iters_dev)
+            flow_up, flow_low = out[0], out[1]
+            iters_used = self._note_iters_used(out[2])
+            pos = 3
         else:
-            flow_up, flow_low = out
+            flow_up, flow_low = out[0], out[1]
+        hidden = None
+        if hidden_out:
+            hidden = tuple(np.asarray(h)[0] for h in out[pos])
         flow_padded = np.asarray(flow_up)[0]
         state = np.ascontiguousarray(np.asarray(flow_low)[0],
                                      dtype=np.float32)
@@ -655,7 +731,8 @@ class InferenceRunner:
         return StreamFrame(flow=np.ascontiguousarray(flow),
                            flow_low=state,
                            seconds=time.perf_counter() - t0,
-                           iters_used=iters_used, warm=warm)
+                           iters_used=iters_used, warm=warm,
+                           hidden=hidden)
 
     def disparity(self, image1: np.ndarray, image2: np.ndarray) -> np.ndarray:
         """Positive disparity map (the demo/user-facing convention,

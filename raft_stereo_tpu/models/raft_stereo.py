@@ -103,7 +103,8 @@ class RAFTStereo(nn.Module):
     def __call__(self, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = 12, flow_init: Optional[jnp.ndarray] = None,
                  test_mode: bool = False, unroll_gru: bool = False,
-                 ctx_init=None, return_ctx: bool = False):
+                 ctx_init=None, return_ctx: bool = False,
+                 hidden_init=None, return_hidden: bool = False):
         """Estimate disparity for a rectified stereo pair.
 
         Args:
@@ -144,14 +145,43 @@ class RAFTStereo(nn.Module):
           return_ctx: test-mode only — also return that context bundle
             (appended as the LAST element of the return tuple) so a
             streaming session can carry it to the next frame.
+          hidden_init: test-mode only — the EVOLVED per-level GRU hidden
+            states a previous frame's ``return_hidden`` output carried
+            (a tuple of (B, H/2^(d+l), W/2^(d+l), hidden_dims[l])
+            arrays).  When given, the refinement loop starts from these
+            states instead of the context encoder's fresh
+            ``tanh(hidden_head)`` init — the half of RAFT's temporal
+            state the round-14 ``flow_init`` warm start left cold.  The
+            context BIASES (cz, cr, cq) still come from this frame's
+            context encoder (or from ``ctx_init`` when both compose):
+            they parameterize the scene, while the hidden state carries
+            the optimization trajectory.  Unsupported with ``rows_gru``
+            (the sharded loop executor owns its own state layout).
+          return_hidden: test-mode only — also return the FINAL
+            per-level hidden states (appended after ``iters_used`` and
+            before the ctx bundle) so a streaming session can chain
+            them.
+
+        Return order (test mode): ``(flow_low, flow_up[, iters_used]
+        [, hidden][, ctx])`` — the optional tails appear only when their
+        flag is set, in that fixed order.
         """
         cfg = self.config
         dtype = self.compute_dtype
         reuse_ctx = ctx_init is not None and not self.is_initializing()
+        reuse_hidden = hidden_init is not None and not self.is_initializing()
         if (ctx_init is not None or return_ctx) and not test_mode:
             raise ValueError("ctx_init/return_ctx are test-mode only "
                              "(the streaming ctx cache is an inference "
                              "feature)")
+        if (hidden_init is not None or return_hidden) and not test_mode:
+            raise ValueError("hidden_init/return_hidden are test-mode "
+                             "only (hidden-state warm start is an "
+                             "inference feature)")
+        if (hidden_init is not None or return_hidden) and cfg.rows_gru:
+            raise ValueError("hidden_init/return_hidden are unsupported "
+                             "with rows_gru (the sharded loop executor "
+                             "owns its own state layout)")
         if reuse_ctx and cfg.shared_backbone:
             raise ValueError(
                 "ctx_init is unsupported with shared_backbone: fnet is "
@@ -286,6 +316,17 @@ class RAFTStereo(nn.Module):
         ctx_out = ((tuple(net_list), tuple(tuple(c) for c in context))
                    if return_ctx else None)
 
+        if reuse_hidden:
+            # Hidden-state warm start: the loop resumes from the previous
+            # frame's EVOLVED states.  Replaces whichever init the branch
+            # above produced (fresh tanh(hidden_head) or the ctx bundle's
+            # saved init) — the context biases keep their source.
+            if len(hidden_init) != len(net_list):
+                raise ValueError(
+                    f"hidden_init carries {len(hidden_init)} levels, "
+                    f"model has {len(net_list)} GRU levels")
+            net_list = [jnp.asarray(h).astype(dtype) for h in hidden_init]
+
         b, h8, w8, _ = net_list[0].shape
         disp = jnp.zeros((b, h8, w8), jnp.float32)
         if flow_init is not None:
@@ -347,12 +388,15 @@ class RAFTStereo(nn.Module):
 
         ctx_tail = (ctx_out,) if return_ctx else ()
 
+        def hidden_tail(net_fin):
+            return (tuple(net_fin),) if return_hidden else ()
+
         if test_mode and unroll_gru:
             mask = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
             for _ in range(iters):
                 net_list, disp, mask = gru_step(self, net_list, disp)
             flow_up = self._upsample(disp, mask)
-            return (disp, flow_up) + ctx_tail
+            return (disp, flow_up) + hidden_tail(net_list) + ctx_tail
 
         if (test_mode and cfg.exit_threshold_px > 0
                 and not self.is_initializing()):
@@ -395,7 +439,8 @@ class RAFTStereo(nn.Module):
             (net_fin, disp_fin, mask_fin, iters_used, _delta) = (
                 nn.while_loop(cond_exit, body_exit, self, carry))
             flow_up = self._upsample(disp_fin, mask_fin)
-            return (disp_fin, flow_up, iters_used) + ctx_tail
+            return ((disp_fin, flow_up, iters_used)
+                    + hidden_tail(net_fin) + ctx_tail)
 
         if test_mode:
             # No per-iteration outputs needed; the scan carries state (plus
@@ -413,7 +458,7 @@ class RAFTStereo(nn.Module):
             (net_fin, disp_fin, mask_fin), _ = scan_test(
                 self, (tuple(net_list), disp, mask0), None)
             flow_up = self._upsample(disp_fin, mask_fin)
-            return (disp_fin, flow_up) + ctx_tail
+            return (disp_fin, flow_up) + hidden_tail(net_fin) + ctx_tail
 
         def body_train(module, carry, _):
             net_list, disp = carry

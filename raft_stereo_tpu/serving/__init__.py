@@ -21,8 +21,12 @@ from raft_stereo_tpu.serving.chaos import (ChaosConfig, ChaosInjector,
                                            InjectedWorkerCrash,
                                            parse_chaos_spec)
 from raft_stereo_tpu.serving.engine import (FAMILY_BASE, FAMILY_STATE,
-                                            FAMILY_STATE_CTX, FAMILY_WARM,
-                                            FAMILY_WARM_CTX, FAMILY_XL,
+                                            FAMILY_STATE_CTX,
+                                            FAMILY_STATE_CTX_H,
+                                            FAMILY_STATE_H, FAMILY_WARM,
+                                            FAMILY_WARM_CTX,
+                                            FAMILY_WARM_CTX_H,
+                                            FAMILY_WARM_H, FAMILY_XL,
                                             BucketPolicy,
                                             ServeConfig, ServeResult,
                                             ServingEngine, StereoService)

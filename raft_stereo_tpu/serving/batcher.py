@@ -114,6 +114,42 @@ class Request:
         return (self.bucket, self.tier, self.family)
 
 
+def edf_key(req: Request) -> float:
+    """The EDF scheduler's priority of one request: its absolute
+    deadline, or — for deadline-less requests — its enqueue stamp.
+    Both are monotonic-clock seconds, and an enqueue stamp is always in
+    the past while a live deadline is in the future, so deadline-less
+    requests sort AHEAD of every deadline-carrying one that arrived
+    after their enqueue: a stream flood can never starve plain traffic
+    (the no-starvation contract, tests/test_edf.py)."""
+    return req.t_enqueue if req.deadline is None else req.deadline
+
+
+def edf_slack_end(reqs: Sequence[Request], now: float,
+                  max_slack_s: float, est_latency_s: float) -> float:
+    """The absolute monotonic time an EDF pop may wait until before
+    dispatching this group — the deliberate-coalescing window.
+
+    Two hard bounds, both ANCHORED (absolute, so a re-evaluating waiter
+    converges instead of sliding):
+
+    * ``head_enqueue + max_slack_s`` — no request waits more than the
+      configured slack beyond its arrival just to fatten a batch;
+    * ``nearest_deadline - est_latency_s`` — the wait must leave the
+      bucket's measured dispatch latency before the earliest deadline
+      in the group, so coalescing can delay a frame but never be the
+      REASON it misses (the bounded-slack contract).
+
+    Groups with no deadline-carrying member return ``now`` — plain
+    requests keep today's immediate-pop behavior."""
+    deadlines = [r.deadline for r in reqs if r.deadline is not None]
+    if not deadlines:
+        return now
+    head_enqueue = min(r.t_enqueue for r in reqs)
+    return min(head_enqueue + max_slack_s,
+               min(deadlines) - est_latency_s)
+
+
 def pick_batch_size(depth: int, sizes: Sequence[int]) -> int:
     """The batch size a pop at queue depth ``depth`` dispatches: the
     largest compiled bucket size the depth fills.  A partial batch (depth
@@ -159,11 +195,33 @@ class BucketQueue:
                  batch_sizes: Sequence[int] = (1, 2, 4, 8),
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 edf: bool = False,
+                 edf_max_slack_s: float = 0.05,
+                 latency_fn=None):
+        """``edf=True`` turns on the round-19 deadline-aware pop policy:
+        groups are taken earliest-deadline-first (``edf_key``) and a pop
+        whose group cannot yet fill the largest compiled batch size
+        WAITS a bounded slack (``edf_slack_end``: at most
+        ``edf_max_slack_s`` past the head's arrival and never closer to
+        the nearest deadline than the bucket's measured dispatch
+        latency) to deliberately coalesce concurrent sessions' frames
+        into one batch-N dispatch.  ``latency_fn(group_key, batch_size)
+        -> seconds | None`` supplies that measured latency (the engine
+        feeds a per-group EWMA of its dispatch wall); None/absent
+        estimates 0.  Deadline-LESS requests keep today's immediate-pop
+        FIFO behavior under either policy, and ``edf=False`` (default)
+        leaves the existing pop path untouched."""
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
+        if edf_max_slack_s < 0:
+            raise ValueError(f"edf_max_slack_s={edf_max_slack_s} must "
+                             f"be >= 0")
+        self.edf = bool(edf)
+        self.edf_max_slack_s = float(edf_max_slack_s)
+        self._latency_fn = latency_fn
         sizes = sorted(set(int(s) for s in batch_sizes if s <= max_batch))
         if not sizes or sizes[0] != 1 or any(s < 1 for s in sizes):
             raise ValueError(
@@ -272,6 +330,32 @@ class BucketQueue:
                 key, oldest = k, reqs[0].t_enqueue
         return key
 
+    def _edf_bucket(self, want=None) -> Optional[Tuple]:
+        """EDF group selection: the group holding the globally smallest
+        ``edf_key`` (earliest deadline; enqueue stamp for deadline-less
+        requests, which therefore sort ahead of any later stream
+        flood)."""
+        key, best = None, None
+        for k, reqs in self._buckets.items():
+            if want is not None and not want(k):
+                continue
+            if not reqs:
+                continue
+            head = min(edf_key(r) for r in reqs)
+            if best is None or head < best:
+                key, best = k, head
+        return key
+
+    def _edf_slack_end_locked(self, group_key: Tuple,
+                              reqs: List[Request], now: float,
+                              sizes: Sequence[int]) -> float:
+        est = 0.0
+        if self._latency_fn is not None:
+            measured = self._latency_fn(group_key, sizes[-1])
+            if measured is not None:
+                est = float(measured)
+        return edf_slack_end(reqs, now, self.edf_max_slack_s, est)
+
     def pop(self, timeout: Optional[float] = None, want=None,
             sizes: Optional[Sequence[int]] = None
             ) -> Optional[List[Request]]:
@@ -304,10 +388,47 @@ class BucketQueue:
                     self._cond.wait(timeout=remaining)
                 if self._closed:
                     return None
-                key = self._oldest_bucket(want)
-                reqs = self._buckets[key]
-                k = pick_batch_size(len(reqs), sizes)
-                batch, rest = reqs[:k], reqs[k:]
+                if self.edf:
+                    key = self._edf_bucket(want)
+                    reqs = self._buckets[key]
+                    now_edf = self._clock()
+                    if len(reqs) < sizes[-1]:
+                        # Bounded-slack coalescing: hold this pop open a
+                        # beat so concurrent sessions' frames merge into
+                        # a bigger compiled batch instead of an idle
+                        # worker instantly dispatching batch-1.  The
+                        # wake time is absolute (edf_slack_end), so
+                        # re-evaluation converges; a submit filling the
+                        # largest size notifies and the re-check
+                        # dispatches immediately.
+                        # Clamped at now + max_slack: the anchors are
+                        # absolute (enqueue stamps / deadlines), so with
+                        # a well-behaved clock the clamp is a no-op —
+                        # it only guards against a stalled or injected
+                        # clock turning the wait into a busy loop.
+                        wake = min(
+                            self._edf_slack_end_locked(
+                                key, reqs, now_edf, sizes),
+                            now_edf + self.edf_max_slack_s)
+                        if wake > now_edf:
+                            self.metrics.edf_slack_waits.inc()
+                            self._cond.wait(timeout=wake - now_edf)
+                            continue   # re-evaluate under the lock
+                    k = pick_batch_size(len(reqs), sizes)
+                    # Earliest-deadline-first WITHIN the group too: the
+                    # popped batch is the k most urgent members (stable
+                    # on ties, so FIFO is preserved among equals).
+                    order = sorted(range(len(reqs)),
+                                   key=lambda i: (edf_key(reqs[i]), i))
+                    take = frozenset(order[:k])
+                    batch = [reqs[i] for i in sorted(take)]
+                    rest = [r for i, r in enumerate(reqs)
+                            if i not in take]
+                else:
+                    key = self._oldest_bucket(want)
+                    reqs = self._buckets[key]
+                    k = pick_batch_size(len(reqs), sizes)
+                    batch, rest = reqs[:k], reqs[k:]
                 if rest:
                     self._buckets[key] = rest
                 else:

@@ -147,6 +147,16 @@ FAMILY_STATE = "state"
 FAMILY_WARM = "warm"
 FAMILY_STATE_CTX = "state_ctx"
 FAMILY_WARM_CTX = "warm_ctx"
+# The warm-h families (round 19, ``ServeConfig.session_hidden``): the
+# ``_h`` variants additionally RETURN the multi-level GRU hidden-state
+# tree (cold frames) and CONSUME it as an extra traced input (warm
+# frames) — eval/runner.make_forward ``hidden_init``/``return_hidden``.
+# Same surface pattern as flow_init (r14) and ctx_init (r15): distinct
+# executable families with their own compile-cost and persist keys.
+FAMILY_STATE_H = "state_h"
+FAMILY_WARM_H = "warm_h"
+FAMILY_STATE_CTX_H = "state_ctx_h"
+FAMILY_WARM_CTX_H = "warm_ctx_h"
 # The xl family (round 17): a fixed-depth base-arity program SHARDED over
 # a rows/corr device-group mesh (eval/runner.make_forward_mesh) — one
 # full-resolution pair answered by several devices.  Only xl device-group
@@ -155,7 +165,17 @@ FAMILY_WARM_CTX = "warm_ctx"
 FAMILY_XL = "xl"
 
 # Families that consume a flow_init input / reuse a context bundle.
-_WARM_FAMILIES = (FAMILY_WARM, FAMILY_WARM_CTX)
+_WARM_FAMILIES = (FAMILY_WARM, FAMILY_WARM_CTX, FAMILY_WARM_H,
+                  FAMILY_WARM_CTX_H)
+# Hidden-tree plumbing (round 19): _H_IN consume the previous frame's
+# hidden tree as a traced input; _H_OUT return this frame's final tree.
+_H_IN_FAMILIES = (FAMILY_WARM_H, FAMILY_WARM_CTX_H)
+_H_OUT_FAMILIES = (FAMILY_STATE_H, FAMILY_WARM_H, FAMILY_STATE_CTX_H,
+                   FAMILY_WARM_CTX_H)
+# Context-bundle plumbing: _CTX_SAVE also return the bundle (cold ctx
+# frames), _CTX_REUSE consume it and skip the context encoder.
+_CTX_SAVE_FAMILIES = (FAMILY_STATE_CTX, FAMILY_STATE_CTX_H)
+_CTX_REUSE_FAMILIES = (FAMILY_WARM_CTX, FAMILY_WARM_CTX_H)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -305,6 +325,18 @@ class ServeConfig:
     # definition.  No effect on fixed-depth tiers (every frame runs the
     # cap there by construction).
     session_reseed_on_cap: bool = True
+    # Hidden-state warm start (round 19): carry the multi-level GRU
+    # hidden-state tree frame to frame alongside the disparity, so a
+    # warm frame resumes the GRU's own trajectory instead of re-deriving
+    # it from the context encoder (the half of RAFT's temporal state the
+    # r14 flow-only warm start left cold — STREAM_r14 measured tight
+    # convergence gates DIVERGING from cold-h warm starts).  Swaps the
+    # state/warm executable families for their ``_h`` variants (distinct
+    # compile-cost + persist keys); the scene-cut fallback, keyframe
+    # guard, and crash demotion invalidate the h-tree in lockstep with
+    # the flow state.  False (default): the r14 flow-only families,
+    # byte-for-byte.  Requires ``sessions``.
+    session_hidden: bool = False
     # Per-session CONTEXT-feature cache (round 15): for streams whose
     # inter-frame thumbnail delta stays tiny (static camera), reuse the
     # session's cnet context bundle instead of re-encoding it every
@@ -322,6 +354,19 @@ class ServeConfig:
     # threshold by design: context reuse assumes the SCENE is static,
     # not merely continuous.
     ctx_cache_threshold: float = 2.0
+    # ---- EDF cross-session frame scheduler (round 19) ------------------
+    # Deadline-aware pop policy (serving/batcher.py): requests carrying
+    # a per-frame deadline are ordered earliest-deadline-first, and an
+    # idle worker whose chosen group cannot yet fill the largest
+    # compiled batch size WAITS a bounded slack — never more than
+    # edf_max_slack_ms past the head frame's arrival, never closer to
+    # the nearest deadline than the bucket's measured dispatch latency —
+    # to deliberately coalesce N concurrent streams' frames into one
+    # batch-N dispatch.  Deadline-less requests keep the immediate-pop
+    # behavior either way; False (default) leaves the scheduler the
+    # exact r11 continuous-batching pop (pinned by tests/test_edf.py).
+    edf_scheduler: bool = False
+    edf_max_slack_ms: float = 50.0
     # ---- Int8 turbo tier (round 15; quant/) ----------------------------
     # Checkpoint-adjacent calibration scale file (quant/calibrate.py):
     # when set, tiers on the int8 path compile with the calibrated
@@ -453,6 +498,13 @@ class ServeConfig:
             if self.session_capacity < 1:
                 raise ValueError(f"session_capacity="
                                  f"{self.session_capacity} must be >= 1")
+        if self.session_hidden and not self.sessions:
+            raise ValueError(
+                "session_hidden=True needs sessions=True — the hidden "
+                "tree is per-stream state")
+        if self.edf_max_slack_ms < 0:
+            raise ValueError(f"edf_max_slack_ms={self.edf_max_slack_ms} "
+                             f"must be >= 0")
         if self.session_ctx_cache:
             if not self.sessions:
                 raise ValueError(
@@ -543,6 +595,13 @@ class ServeResult:
     # state_ctx frame computed, folded back into the session.
     ctx_cached: bool = False
     ctx: Optional[object] = None
+    # Hidden-state provenance (round 19, ``ServeConfig.session_hidden``):
+    # the frame's FINAL per-level GRU hidden tree (batch-axis-free host
+    # arrays) the session chains into the next frame's warm-h dispatch,
+    # and whether THIS frame consumed one (``warm_hidden`` — the warm-h
+    # families).
+    hidden: Optional[object] = None
+    warm_hidden: bool = False
     # XL/tiling provenance (round 17): ``mesh`` — the compact mesh label
     # ("rows4") when this request ran as a mesh-sharded xl dispatch
     # (``tier`` reads "xl" then); ``tiles`` — how many halo-overlap tile
@@ -573,6 +632,7 @@ class _Payload:
     right: np.ndarray
     padder: InputPadder
     flow_init: Optional[np.ndarray] = None   # (Hp/f, Wp/f) f32, warm only
+    hidden_init: Optional[object] = None     # warm-h: per-level hidden tree
     session: Optional[object] = None         # sessions.StereoSession
     thumb: Optional[np.ndarray] = None       # THIS frame's thumbnail
     raw_shape: Optional[Tuple[int, int]] = None
@@ -850,10 +910,21 @@ class ServingEngine:
         self._cache_lock = threading.Lock()
         self._compiled: "collections.OrderedDict[Tuple, object]" = (
             collections.OrderedDict())
+        # Per-group dispatch-latency EWMA (seconds, device + fetch):
+        # what the EDF bounded-slack derivation subtracts from the
+        # nearest deadline so coalescing can delay a frame but never be
+        # the reason it misses.  Updated after every dispatch
+        # (_note_dispatch_latency); a group with no measurement yet
+        # estimates 0 — the slack then bounds only on edf_max_slack_ms.
+        self._latency_lock = threading.Lock()
+        self._dispatch_latency_s: Dict[Tuple, float] = {}
         self.queue = BucketQueue(
             max_batch=serve_cfg.max_batch,
             batch_sizes=serve_cfg.batch_sizes,
-            max_queue=serve_cfg.max_queue, metrics=self.metrics)
+            max_queue=serve_cfg.max_queue, metrics=self.metrics,
+            edf=serve_cfg.edf_scheduler,
+            edf_max_slack_s=serve_cfg.edf_max_slack_ms / 1e3,
+            latency_fn=self._dispatch_latency_estimate)
         # ---- XL tier: mesh-sharded device groups (round 17) ------------
         # ``self.xl`` is an _XlTier (mesh spec + per-group meshes +
         # replicated variables) or None — None either because no xl_mesh
@@ -1202,6 +1273,21 @@ class ServingEngine:
         """The padded (Hp, Wp) this image shape dispatches at."""
         return self.policy.bucket_for(shape[0], shape[1])[:2]
 
+    def _dispatch_latency_estimate(self, group_key: Tuple,
+                                   batch_size: int) -> Optional[float]:
+        """The measured per-dispatch wall (device + fetch EWMA) of one
+        queue group — the EDF scheduler's slack subtrahend.  None before
+        the group's first dispatch."""
+        with self._latency_lock:
+            return self._dispatch_latency_s.get(group_key)
+
+    def _note_dispatch_latency(self, group_key: Tuple,
+                               seconds: float) -> None:
+        with self._latency_lock:
+            prev = self._dispatch_latency_s.get(group_key)
+            self._dispatch_latency_s[group_key] = (
+                seconds if prev is None else 0.7 * prev + 0.3 * seconds)
+
     def resolve_tier(self, tier: Optional[str]) -> Optional[str]:
         """The tier a request actually runs at: the named one (validated),
         or the default tier when tiers are configured, or None (the base
@@ -1308,7 +1394,7 @@ class ServingEngine:
                  frame_index: Optional[int] = None,
                  scene_cut: bool = False,
                  frame_delta_v: Optional[float] = None,
-                 ctx_init=None) -> Request:
+                 ctx_init=None, hidden_init=None) -> Request:
         """Pad, build, trace, and queue one request — shared by the
         stateless ``submit`` (base family, no session fields) and the
         streaming ``submit_session``."""
@@ -1319,6 +1405,7 @@ class ServingEngine:
         payload = _Payload(left=np.pad(left, spec, mode="edge"),
                            right=np.pad(right, spec, mode="edge"),
                            padder=padder, flow_init=flow_init,
+                           hidden_init=hidden_init,
                            session=session, thumb=thumb,
                            raw_shape=tuple(left.shape[:2]),
                            frame_index=frame_index, scene_cut=scene_cut,
@@ -1534,9 +1621,16 @@ class ServingEngine:
             thumb = frame_thumbnail(left)
             hp, wp, _grid = self.policy.bucket_for(left.shape[0],
                                                    left.shape[1])
+            hidden_on = self.serve_cfg.session_hidden
             warm = (not created and sess.flow_low is not None
                     and sess.bucket == (hp, wp)
-                    and sess.raw_shape == tuple(left.shape[:2]))
+                    and sess.raw_shape == tuple(left.shape[:2])
+                    # warm-h programs consume BOTH state halves: a
+                    # session missing its hidden tree (dropped at
+                    # export, invalidated by a crash) cold-starts
+                    # rather than feeding the warm-h executable a
+                    # fabricated trajectory.
+                    and (not hidden_on or sess.hidden is not None))
             scene_cut = False
             delta = None
             if warm:
@@ -1563,18 +1657,24 @@ class ServingEngine:
             ctx_on = self.serve_cfg.session_ctx_cache
             ctx_init = None
             if warm:
-                family = FAMILY_WARM
+                family = FAMILY_WARM_H if hidden_on else FAMILY_WARM
                 if (ctx_on and sess.ctx is not None and delta is not None
                         and delta <= self.serve_cfg.ctx_cache_threshold):
-                    family = FAMILY_WARM_CTX
+                    family = (FAMILY_WARM_CTX_H if hidden_on
+                              else FAMILY_WARM_CTX)
                     ctx_init = sess.ctx
+            elif ctx_on:
+                family = (FAMILY_STATE_CTX_H if hidden_on
+                          else FAMILY_STATE_CTX)
             else:
-                family = FAMILY_STATE_CTX if ctx_on else FAMILY_STATE
+                family = FAMILY_STATE_H if hidden_on else FAMILY_STATE
             req = self._enqueue(
                 left, right, deadline_ms, tier, requested_tier, t_admit,
                 family=family,
                 session=sess, session_id=session_id,
                 flow_init=sess.flow_low if warm else None,
+                hidden_init=(sess.hidden if warm and hidden_on
+                             else None),
                 ctx_init=ctx_init,
                 thumb=thumb, frame_index=sess.frame_index,
                 scene_cut=scene_cut, frame_delta_v=delta)
@@ -1599,10 +1699,40 @@ class ServingEngine:
             handoff_key=handoff_key).result(timeout=timeout)
 
     # ------------------------------------------------------ session handoff
+    def exec_config_fingerprint(self) -> str:
+        """SHA-256 identity of the compiled surface a handed-off session
+        would re-enter here: the effective model config (architecture,
+        precision, quant — array geometry and dtypes of every state
+        tree) plus the serving knobs that pick the session executable
+        families (``session_hidden`` / ``session_ctx_cache``), the GRU
+        depth cap, and the fetch dtype.  Stamped onto every published
+        handoff blob; an importer whose fingerprint differs refuses the
+        blob TYPED (``serve_handoff_import_skipped_total{reason=
+        "config_mismatch"}``) instead of silently installing state its
+        programs cannot consume — deliberately coarse: any drift costs
+        one cold start per stream, which is the cheap failure."""
+        import hashlib
+
+        payload = {
+            "model": self.effective_config.to_json(),
+            "session_hidden": self.serve_cfg.session_hidden,
+            "session_ctx_cache": self.serve_cfg.session_ctx_cache,
+            "iters": self.serve_cfg.iters,
+            "fetch_dtype": self.serve_cfg.fetch_dtype,
+        }
+        import json as json_mod
+        return hashlib.sha256(
+            json_mod.dumps(payload, sort_keys=True).encode()).hexdigest()
+
     def _handoff_records(self, key: str) -> Dict:
         """Parsed ``{sid: (meta, arrays)}`` of one published handoff
         blob, fetched and decoded at most once per key (N inherited
-        sessions share one artifact read)."""
+        sessions share one artifact read).  A blob stamped with a
+        DIFFERENT exec-config fingerprint than this engine's is refused
+        wholesale — every session it carries counts into
+        ``serve_handoff_import_skipped_total{reason="config_mismatch"}``
+        and cold-starts (the r18 follow-up: mismatch is typed, never a
+        silent wrong-geometry import)."""
         with self._handoff_lock:
             cached = self._handoff_blobs.get(key)
         if cached is not None:
@@ -1611,9 +1741,24 @@ class ServingEngine:
         if self.handoff_store is not None:
             blob = self.handoff_store.fetch(key)
             if blob is not None:
-                records, skipped = parse_handoff_blob(blob)
-                if skipped:
-                    self.metrics.handoff_import_skipped.inc(skipped)
+                from raft_stereo_tpu.serving.sessions import (
+                    handoff_fingerprint, handoff_session_ids)
+                stamped = handoff_fingerprint(blob)
+                mine = self.exec_config_fingerprint()
+                if stamped is not None and stamped != mine:
+                    n = len(handoff_session_ids(blob))
+                    self.metrics.observe_handoff_skip("config_mismatch",
+                                                      n)
+                    log.warning(
+                        "handoff artifact %s was exported under exec-"
+                        "config %.12s but this engine compiles %.12s; "
+                        "refusing %d session(s) — they cold-start "
+                        "(config_mismatch)", key[:12], stamped, mine, n)
+                else:
+                    records, skipped = parse_handoff_blob(blob)
+                    if skipped:
+                        self.metrics.observe_handoff_skip("corrupt",
+                                                          skipped)
             else:
                 log.warning("handoff artifact %s not in the store; its "
                             "sessions cold-start", key)
@@ -1654,7 +1799,8 @@ class ServingEngine:
         path when the process exits."""
         if self.sessions is None or self.handoff_store is None:
             return None
-        blob = self.sessions.export()
+        blob = self.sessions.export(
+            config_fingerprint=self.exec_config_fingerprint())
         sids = handoff_session_ids(blob)
         key = None
         if sids:
@@ -1667,7 +1813,8 @@ class ServingEngine:
             else:
                 self.metrics.sessions_exported.inc(len(sids))
         manifest = {"artifact": key, "sessions": sids,
-                    "count": len(sids), "published_unix": time.time()}
+                    "count": len(sids), "published_unix": time.time(),
+                    "config_fingerprint": self.exec_config_fingerprint()}
         self._handoff_manifest = manifest
         log.info("session handoff published: %d session(s) -> %s",
                  len(sids), key and key[:12])
@@ -1739,7 +1886,11 @@ class ServingEngine:
                 sess.note_result(
                     flow_low=flow_low, thumb=req.payload.thumb,
                     bucket=req.bucket, raw_shape=req.payload.raw_shape,
-                    warm=res.warm, iters_used=res.iters_used)
+                    warm=res.warm, iters_used=res.iters_used,
+                    # The hidden tree rides (and drops) with the flow
+                    # state: the keyframe guard's flow_low=None above
+                    # zeroes both halves inside note_result.
+                    hidden=res.hidden)
                 self.metrics.observe_session_frame(
                     "warm" if res.warm else "cold")
         finally:
@@ -1799,12 +1950,22 @@ class ServingEngine:
         cost, and readiness target are exactly the round-13 ones); the
         ctx-cache variants replace state/warm when the per-session
         context cache is on (cold frames must SAVE the bundle for warm
-        frames to reuse, so plain "state" never runs there)."""
+        frames to reuse, so plain "state" never runs there); with
+        ``session_hidden`` every session family swaps for its ``_h``
+        variant (all session programs must carry the hidden tree —
+        otherwise one un-carried frame would silently break the warm-h
+        chain)."""
         if self.sessions is None:
             return (FAMILY_BASE,)
+        hidden = self.serve_cfg.session_hidden
         if self.serve_cfg.session_ctx_cache:
+            if hidden:
+                return (FAMILY_BASE, FAMILY_STATE_CTX_H, FAMILY_WARM_H,
+                        FAMILY_WARM_CTX_H)
             return (FAMILY_BASE, FAMILY_STATE_CTX, FAMILY_WARM,
                     FAMILY_WARM_CTX)
+        if hidden:
+            return (FAMILY_BASE, FAMILY_STATE_H, FAMILY_WARM_H)
         return (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)
 
     # ------------------------------------------------------- tier variables
@@ -1853,6 +2014,12 @@ class ServingEngine:
             ctxs.append(tuple(jax.ShapeDtypeStruct((batch, h, w, c), dt)
                               for _ in range(3)))
         return (tuple(nets), tuple(ctxs))
+
+    def _hidden_avals(self, cfg, bucket: Tuple[int, int], batch: int):
+        """Abstract shapes of one hidden-state tree at ``bucket`` — the
+        per-level evolved GRU states the warm-h families consume
+        (identical geometry to the ctx bundle's net half)."""
+        return self._ctx_avals(cfg, bucket, batch)[0]
 
     # --------------------------------------------------------- compile cache
     def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
@@ -1938,9 +2105,11 @@ class ServingEngine:
                 warm_start=(family in _WARM_FAMILIES),
                 return_state=(family is not FAMILY_BASE
                               and family != FAMILY_XL),
-                ctx=("save" if family == FAMILY_STATE_CTX
-                     else "reuse" if family == FAMILY_WARM_CTX
-                     else None))
+                ctx=("save" if family in _CTX_SAVE_FAMILIES
+                     else "reuse" if family in _CTX_REUSE_FAMILIES
+                     else None),
+                hidden_init=(family in _H_IN_FAMILIES),
+                return_hidden=(family in _H_OUT_FAMILIES))
         if self.disk_cache is not None:
             fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
                                         family)
@@ -2009,6 +2178,11 @@ class ServingEngine:
             fetch_dtype=self.serve_cfg.fetch_dtype,
             donate=self.serve_cfg.donate_buffers,
             family=family, flow_init=(family in _WARM_FAMILIES),
+            # The hidden-tree arity (round 19): warm-h programs take an
+            # extra traced input tree and every _h program returns one —
+            # the family string above already separates them, but the
+            # explicit coordinate keeps the key self-describing.
+            hidden=(family in _H_IN_FAMILIES),
             # Belt and braces for the int8 tier: the quant mode is
             # already inside the config JSON above, but it also keys
             # explicitly — a quantized and a base executable consume
@@ -2052,7 +2226,9 @@ class ServingEngine:
             f = tier_cfg.downsample_factor
             avals.append(jax.ShapeDtypeStruct(
                 (batch, bucket[0] // f, bucket[1] // f), np.float32))
-        if family == FAMILY_WARM_CTX:
+        if family in _H_IN_FAMILIES:
+            avals.append(self._hidden_avals(tier_cfg, bucket, batch))
+        if family in _CTX_REUSE_FAMILIES:
             avals.append(self._ctx_avals(tier_cfg, bucket, batch))
         try:
             compiled = fwd.lower(self._vars_for(worker, cache_tier),
@@ -2141,7 +2317,14 @@ class ServingEngine:
                             args.append(jax.device_put(
                                 np.zeros((n, hp // f, wp // f),
                                          np.float32), dev))
-                        if family == FAMILY_WARM_CTX:
+                        if family in _H_IN_FAMILIES:
+                            import jax.tree_util as jtu
+                            args.append(jtu.tree_map(
+                                lambda s: jax.device_put(
+                                    np.zeros(s.shape, s.dtype), dev),
+                                self._hidden_avals(tier_cfg, (hp, wp),
+                                                   n)))
+                        if family in _CTX_REUSE_FAMILIES:
                             import jax.tree_util as jtu
                             ctx_zeros = jtu.tree_map(
                                 lambda s: jax.device_put(
@@ -2300,15 +2483,20 @@ class ServingEngine:
         tests/test_sessions.py."""
         sess = req.payload.session
         if req.family in _WARM_FAMILIES:
-            req.family = (FAMILY_STATE_CTX
-                          if self.serve_cfg.session_ctx_cache
-                          else FAMILY_STATE)
+            ctx_on = self.serve_cfg.session_ctx_cache
+            if self.serve_cfg.session_hidden:
+                req.family = (FAMILY_STATE_CTX_H if ctx_on
+                              else FAMILY_STATE_H)
+            else:
+                req.family = FAMILY_STATE_CTX if ctx_on else FAMILY_STATE
             req.payload.flow_init = None
+            req.payload.hidden_init = None
             req.payload.ctx_init = None
             log.warning("session %s frame %s: crashed warm dispatch "
                         "demoted to a cold start for its retry",
                         req.session_id, req.payload.frame_index)
         sess.flow_low = None
+        sess.hidden = None
         sess.ctx = None
 
     def _schedule_requeue(self, reqs: List[Request],
@@ -2428,7 +2616,16 @@ class ServingEngine:
                 fi = np.stack([r.payload.flow_init for r in batch]
                               ).astype(np.float32)
                 args.append(jax.device_put(fi, device))
-            if family == FAMILY_WARM_CTX:
+            if family in _H_IN_FAMILIES:
+                # Hidden warm start: the batch members' per-level hidden
+                # trees stack leaf-wise (frames of DIFFERENT sessions
+                # batch together; each leaf is per-image along axis 0).
+                import jax.tree_util as jtu
+                hidden_stacked = jtu.tree_map(
+                    lambda *xs: np.stack(xs),
+                    *[r.payload.hidden_init for r in batch])
+                args.append(jax.device_put(hidden_stacked, device))
+            if family in _CTX_REUSE_FAMILIES:
                 # Context reuse: the batch members' cached bundles stack
                 # leaf-wise (frames of DIFFERENT static-scene sessions
                 # batch together; each leaf is per-image along axis 0).
@@ -2448,13 +2645,22 @@ class ServingEngine:
         with profiling.annotate("serve.fetch"):
             flow_low_padded = None
             ctx_out = None
-            if family == FAMILY_STATE_CTX:
+            hidden_out = None
+            if family in _CTX_SAVE_FAMILIES:
                 # The ctx-saving cold program appends the context bundle
                 # LAST (eval/runner.make_forward): peel it off, fetch it
                 # to host leaves (numpy; bf16 leaves ride as ml_dtypes).
                 import jax.tree_util as jtu
                 out, ctx_dev = out[:-1], out[-1]
                 ctx_out = jtu.tree_map(lambda x: np.asarray(x), ctx_dev)
+            if family in _H_OUT_FAMILIES:
+                # The hidden tree rides just before the ctx bundle
+                # (return order: flow_up, flow_low[, iters][, hidden]
+                # [, ctx]) — now the LAST remaining element.
+                import jax.tree_util as jtu
+                out, hidden_dev = out[:-1], out[-1]
+                hidden_out = jtu.tree_map(lambda x: np.asarray(x),
+                                          hidden_dev)
             if family is FAMILY_BASE or xl:
                 if adaptive:
                     flows, iters_used_dev = out
@@ -2485,6 +2691,10 @@ class ServingEngine:
 
         device_s = t_ready - t_pickup
         fetch_s = t_fetched - t_ready
+        # Per-group dispatch-latency EWMA: the EDF scheduler's bounded
+        # slack subtracts this from the nearest deadline.
+        self._note_dispatch_latency(batch[0].group_key,
+                                    device_s + fetch_s)
         self.metrics.observe_dispatch(n)
         if xl:
             self.metrics.xl_dispatches.inc()
@@ -2540,6 +2750,11 @@ class ServingEngine:
                 # into any later dispatch.
                 import jax.tree_util as jtu
                 ctx_i = jtu.tree_map(lambda leaf, j=i: leaf[j], ctx_out)
+            hidden_i = None
+            if hidden_out is not None:
+                import jax.tree_util as jtu
+                hidden_i = jtu.tree_map(lambda leaf, j=i: leaf[j],
+                                        hidden_out)
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
@@ -2554,8 +2769,10 @@ class ServingEngine:
                 frame_delta=r.payload.frame_delta,
                 flow_low=(np.ascontiguousarray(flow_low_padded[i])
                           if flow_low_padded is not None else None),
-                ctx_cached=(family == FAMILY_WARM_CTX),
-                ctx=ctx_i))
+                ctx_cached=(family in _CTX_REUSE_FAMILIES),
+                ctx=ctx_i,
+                hidden=hidden_i,
+                warm_hidden=(family in _H_IN_FAMILIES)))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

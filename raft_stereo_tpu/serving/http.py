@@ -223,6 +223,12 @@ def make_handler(service: StereoService,
                     "sessions_active": (
                         service.sessions.active_count
                         if service.sessions is not None else None),
+                    # Streaming-v2 surface (round 19): whether frames
+                    # carry the GRU hidden state across dispatches and
+                    # whether the deadline-aware coalescing scheduler
+                    # is on — what the multi-stream smoke keys off.
+                    "session_hidden": service.serve_cfg.session_hidden,
+                    "edf_scheduler": service.serve_cfg.edf_scheduler,
                     "devices": len(service.devices),
                     "xl": service.xl_status()})
             elif path == "/readyz":

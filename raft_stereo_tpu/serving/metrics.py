@@ -211,10 +211,13 @@ class ServingMetrics:
             "streaming sessions whose state was imported from another "
             "replica's handoff blob at the session's first frame here "
             "(X-Handoff-Artifact; the frame dispatches WARM)")
-        self.handoff_import_skipped = r.counter(
-            "serve_handoff_import_skipped_total",
-            "handoff entries that failed their checksum / parse and "
-            "degraded to a cold start (never a crash)")
+        # serve_handoff_import_skipped_total{reason=...}: a labeled
+        # family (round 19) — "corrupt" entries failed their checksum /
+        # parse; "config_mismatch" blobs carried another exec-config
+        # fingerprint than this engine compiles (r18 follow-up: the
+        # mismatch is TYPED, never a silent cold start).
+        self._handoff_skip_lock = threading.Lock()
+        self._handoff_skip_by_reason: Dict[str, Counter] = {}
         self.frame_delta = r.histogram(
             "serve_session_frame_delta",
             "mean |delta intensity| (0..255) between consecutive session "
@@ -254,6 +257,16 @@ class ServingMetrics:
             buckets=SEAM_EPE_BUCKETS)
         self._xl_hbm_lock = threading.Lock()
         self._xl_hbm: Dict[Tuple[str, str], Gauge] = {}
+        # EDF scheduler accounting (round 19, serving/batcher.py): how
+        # often a pop deliberately held open to coalesce concurrent
+        # sessions' frames.  The coalescing RESULT reads off the
+        # existing serve_requests_completed_total / serve_batches_total
+        # ratio (frames per dispatch).
+        self.edf_slack_waits = r.counter(
+            "serve_edf_slack_waits_total",
+            "EDF pops that waited a bounded slack to coalesce "
+            "deadline-carrying frames into a larger batch "
+            "(edf_scheduler; 0 with the policy off)")
         self.last_batch_unix = r.gauge(
             "serve_last_batch_unix_seconds",
             "wall-clock time the last micro-batch finished (0 until one "
@@ -358,6 +371,32 @@ class ServingMetrics:
         first dispatch — what the smoke/bench harnesses assert on."""
         with self._iters_lock:
             return self._iters_by_tier.get(tier)
+
+    def observe_handoff_skip(self, reason: str, n: int = 1) -> None:
+        """Count ``n`` handoff sessions skipped at import into the
+        per-reason ``serve_handoff_import_skipped_total{reason=...}``
+        family ("corrupt" | "config_mismatch")."""
+        if n <= 0:
+            return
+        with self._handoff_skip_lock:
+            c = self._handoff_skip_by_reason.get(reason)
+            if c is None:
+                c = self.registry.counter(
+                    "serve_handoff_import_skipped_total",
+                    "handoff sessions skipped at import, by reason "
+                    "(corrupt = checksum/parse failure; config_mismatch "
+                    "= the blob's exec-config fingerprint differs from "
+                    "this engine's) — each degrades that session to a "
+                    "cold start, never a crash",
+                    labels={"reason": reason})
+                self._handoff_skip_by_reason[reason] = c
+        c.inc(n)
+
+    def handoff_skips(self, reason: str) -> int:
+        """Skipped-session count for one reason (0 before the first)."""
+        with self._handoff_skip_lock:
+            c = self._handoff_skip_by_reason.get(reason)
+        return 0 if c is None else c.value
 
     def observe_session_frame(self, mode: str) -> None:
         """Count one completed session frame into the per-mode

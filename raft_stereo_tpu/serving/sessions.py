@@ -125,8 +125,20 @@ def frame_delta(thumb_a: Optional[np.ndarray],
 # Arrays are packed as plain ``np.save`` segments (allow_pickle=False on
 # the way back in) under a tiny recursive tree spec, so the ctx bundle's
 # nested tuples survive without pickle.
+#
+# Version 2 (round 19): entries additionally pack the GRU hidden-state
+# tree (``StereoSession.hidden``, the warm-h chain's second state half)
+# and the manifest carries the EXPORTING engine's exec-config
+# fingerprint so an importer with a different compiled surface (other
+# model config / iters / h-family knobs) degrades TYPED instead of
+# silently installing state its programs cannot consume.  Version-1
+# blobs (no hidden, no fingerprint) are rejected by the version check —
+# their sessions cold-start, the documented degrade.
 HANDOFF_MAGIC = b"RSTPU-SESS"
-HANDOFF_VERSION = 1
+HANDOFF_VERSION = 2
+
+# Array trees one session entry packs (in spec order).
+_RECORD_ARRAYS = ("flow_low", "thumb", "ctx", "hidden")
 
 # StereoSession counters that ride the handoff verbatim.
 _RECORD_COUNTERS = ("frame_index", "warm_frames", "cold_frames",
@@ -173,10 +185,15 @@ def _entry_digest(meta: Dict[str, object], payload: bytes) -> str:
 
 
 def export_sessions_blob(records: Iterable[Tuple[Dict[str, object],
-                                                 Dict[str, object]]]
+                                                 Dict[str, object]]],
+                         config_fingerprint: Optional[str] = None
                          ) -> bytes:
     """Serialize ``(meta, arrays)`` session records (see
-    ``StereoSession.to_record``) into one handoff blob."""
+    ``StereoSession.to_record``) into one handoff blob.
+    ``config_fingerprint`` (engine.exec_config_fingerprint) stamps the
+    manifest so an importer with a DIFFERENT compiled surface (model
+    config / iters / h-family knobs) can refuse the whole blob typed
+    instead of installing state its programs cannot consume."""
     entries: List[Dict[str, object]] = []
     body = io.BytesIO()
     for meta, arrays in records:
@@ -184,18 +201,21 @@ def export_sessions_blob(records: Iterable[Tuple[Dict[str, object],
         spec: Dict[str, object] = {}
         for name in ("flow_low", "thumb"):
             spec[name] = _pack_tree(arrays.get(name), seg)
-        try:
-            spec["ctx"] = _pack_tree(arrays.get("ctx"), seg)
-        except (TypeError, ValueError, OSError):
-            # The ctx bundle can carry backend-exotic leaves (bf16 via
-            # ml_dtypes) np.save may refuse.  Warmth only needs the
-            # flow: drop the bundle, it re-establishes at the next cold
-            # ctx frame on the importer.
-            seg.seek(0)
-            seg.truncate()
-            spec = {name: _pack_tree(arrays.get(name), seg)
-                    for name in ("flow_low", "thumb")}
-            spec["ctx"] = {"k": "none"}
+        for name in ("ctx", "hidden"):
+            mark = seg.tell()
+            try:
+                spec[name] = _pack_tree(arrays.get(name), seg)
+            except (TypeError, ValueError, OSError):
+                # These trees can carry backend-exotic leaves (bf16 via
+                # ml_dtypes) np.save may refuse.  Warmth only needs the
+                # flow: drop the tree — the ctx bundle re-establishes at
+                # the next cold ctx frame on the importer, and a missing
+                # hidden tree demotes that session's first inherited
+                # frame to a cold start (the r14 baseline, never a torn
+                # state).
+                seg.seek(mark)
+                seg.truncate()
+                spec[name] = {"k": "none"}
         payload = seg.getvalue()
         entries.append({"id": meta["session_id"], "meta": meta,
                         "spec": spec, "offset": body.tell(),
@@ -203,6 +223,7 @@ def export_sessions_blob(records: Iterable[Tuple[Dict[str, object],
                         "sha256": _entry_digest(meta, payload)})
         body.write(payload)
     manifest = json.dumps({"version": HANDOFF_VERSION,
+                           "config_fingerprint": config_fingerprint,
                            "sessions": entries}).encode()
     return (HANDOFF_MAGIC + struct.pack("<HI", HANDOFF_VERSION,
                                         len(manifest))
@@ -216,6 +237,17 @@ def handoff_session_ids(blob: bytes) -> List[str]:
     if manifest is None:
         return []
     return [str(e.get("id")) for e in manifest.get("sessions", ())]
+
+
+def handoff_fingerprint(blob: bytes) -> Optional[str]:
+    """The exporting engine's exec-config fingerprint a handoff blob
+    was stamped with (header-only read; None on anything unparseable or
+    an unstamped blob)."""
+    manifest = _handoff_manifest(blob)
+    if manifest is None:
+        return None
+    fp = manifest.get("config_fingerprint")
+    return str(fp) if fp is not None else None
 
 
 def _handoff_manifest(blob: bytes) -> Optional[Dict[str, object]]:
@@ -264,8 +296,9 @@ def parse_handoff_blob(blob: bytes
             meta = entry["meta"]
             if _entry_digest(meta, payload) != entry["sha256"]:
                 raise ValueError("checksum mismatch")
-            arrays = {name: _unpack_tree(entry["spec"][name], payload)
-                      for name in ("flow_low", "thumb", "ctx")}
+            arrays = {name: _unpack_tree(
+                          entry["spec"].get(name, {"k": "none"}), payload)
+                      for name in _RECORD_ARRAYS}
             out[str(entry["id"])] = (meta, arrays)
         except Exception:   # noqa: BLE001 — per-entry degradation
             skipped += 1
@@ -297,6 +330,13 @@ class StereoSession:
     # frame past the static-scene gate).
     ctx: Optional[object] = None
     ctx_hits: int = 0             # frames served with the cached context
+    # Final per-level GRU hidden states of the previous frame (tuple of
+    # batch-axis-free host arrays) — the warm-h chain's second state
+    # half (round 19, ``ServeConfig.session_hidden``).  Carried and
+    # invalidated in LOCKSTEP with ``flow_low``: scene cuts, the
+    # keyframe guard, and crash demotion drop both, so a warm-h frame
+    # never mixes a fresh disparity with a stale trajectory.
+    hidden: Optional[object] = None
     frame_index: int = 0          # frames COMPLETED (the next frame's index)
     warm_frames: int = 0
     cold_frames: int = 0
@@ -312,13 +352,17 @@ class StereoSession:
     def note_result(self, flow_low: Optional[np.ndarray],
                     thumb: Optional[np.ndarray],
                     bucket: Tuple[int, int], raw_shape: Tuple[int, int],
-                    warm: bool, iters_used: Optional[int]) -> None:
+                    warm: bool, iters_used: Optional[int],
+                    hidden: Optional[object] = None) -> None:
         """Fold one completed frame into the state (called by the engine
         while ``order_lock`` is held, so no torn reads are possible).
         ``flow_low=None`` drops the warm-start state — the engine's
         keyframe guard passes None when the frame never converged, so
-        the next frame cold-starts."""
+        the next frame cold-starts.  ``hidden`` rides (and drops) with
+        it: a dropped flow with a kept trajectory would be exactly the
+        torn state the lockstep rule forbids."""
         self.flow_low = flow_low
+        self.hidden = hidden if flow_low is not None else None
         self.thumb = thumb
         self.bucket = tuple(bucket)
         self.raw_shape = tuple(raw_shape)
@@ -344,7 +388,7 @@ class StereoSession:
         for name in _RECORD_COUNTERS:
             meta[name] = int(getattr(self, name))
         return meta, {"flow_low": self.flow_low, "thumb": self.thumb,
-                      "ctx": self.ctx}
+                      "ctx": self.ctx, "hidden": self.hidden}
 
     def apply_record(self, meta: Dict[str, object],
                      arrays: Dict[str, object]) -> None:
@@ -360,6 +404,7 @@ class StereoSession:
         self.flow_low = arrays.get("flow_low")
         self.thumb = arrays.get("thumb")
         self.ctx = arrays.get("ctx")
+        self.hidden = arrays.get("hidden")
 
     def iters_used_mean(self) -> Optional[float]:
         """Per-session mean GRU trip count — the number the close stats
@@ -527,13 +572,15 @@ class SessionStore:
         return sess.stats()
 
     # -------------------------------------------------------------- handoff
-    def export(self) -> bytes:
+    def export(self, config_fingerprint: Optional[str] = None) -> bytes:
         """Serialize every live session into one versioned, checksummed
         handoff blob (the graceful-drain path; engine.publish_handoff).
         Acquires each session's ordering lock, so a frame still in
         flight completes — and folds its state in — before that session
         is captured; with admission already stopped (begin_shutdown)
-        every lock wait is bounded by one frame's latency."""
+        every lock wait is bounded by one frame's latency.
+        ``config_fingerprint`` stamps the blob with the exporter's
+        exec-config identity (round-19 mismatch-typed import)."""
         with self._lock:
             self._sweep_locked(self._clock())
             sessions = list(self._sessions.values())
@@ -541,15 +588,31 @@ class SessionStore:
         for sess in sessions:
             with sess.order_lock:
                 records.append(sess.to_record())
-        return export_sessions_blob(records)
+        return export_sessions_blob(records,
+                                    config_fingerprint=config_fingerprint)
 
-    def import_(self, blob: bytes,
-                overwrite: bool = False) -> Tuple[int, int]:
+    def import_(self, blob: bytes, overwrite: bool = False,
+                expect_fingerprint: Optional[str] = None
+                ) -> Tuple[int, int]:
         """Bulk-install a handoff blob's sessions; returns ``(imported,
         skipped)``.  Corrupt entries, tombstoned ids, and (without
         ``overwrite``) ids already live here are skipped — an import can
         only ever ADD warmth, never clobber a stream this store is
-        actively serving or resurrect one it deliberately killed."""
+        actively serving or resurrect one it deliberately killed.
+        With ``expect_fingerprint`` set, a blob stamped with a DIFFERENT
+        exporter fingerprint is refused wholesale — every session counts
+        skipped (the typed config-mismatch degrade; the engine's lazy
+        adoption path applies the same check with its own metric)."""
+        if expect_fingerprint is not None:
+            stamped = handoff_fingerprint(blob)
+            if stamped is not None and stamped != expect_fingerprint:
+                n = len(handoff_session_ids(blob))
+                log.warning(
+                    "handoff blob exec-config fingerprint %.12s != this "
+                    "store's %.12s; refusing %d session(s) — they "
+                    "cold-start (config_mismatch)", stamped,
+                    expect_fingerprint, n)
+                return 0, n
         records, skipped = parse_handoff_blob(blob)
         now = self._clock()
         imported = 0

@@ -21,7 +21,13 @@ Asserts:
 * an expired session id gets the typed 410 and ``DELETE`` returns the
   session's lifetime stats;
 * the sessionless ``POST /v1/disparity`` path still answers (stateless
-  traffic and streams share one engine).
+  traffic and streams share one engine);
+* **multi-stream leg (round 19)**: 4 concurrent sessions over HTTP
+  through an engine with ``session_hidden`` + the EDF bounded-slack
+  scheduler must produce FEWER device dispatches than frames (the
+  cross-session coalescing observed in the metrics), and warm-h frames
+  must use <= the warm-flow-only leg's GRU iterations (the hidden
+  state can only help convergence) — STREAM_ci.json asserts both.
 
 Writes ``STREAM_ci.json`` (set STREAM_CI_OUT; CI uploads it).  Exit 0 on
 success, non-zero with a diagnostic on any failed assertion.
@@ -60,14 +66,18 @@ ITERS_CAP = 8
 TIER = "stream:2.0:1"
 
 
-def _post_frame(url: str, sid: str, left, right, tier: str):
+def _post_frame(url: str, sid: str, left, right, tier: str,
+                deadline_ms=None):
     import numpy as np
 
     buf = io.BytesIO()
     np.savez(buf, left=left, right=right)
+    headers = {"Content-Type": "application/x-npz"}
+    if deadline_ms is not None:
+        headers["X-Deadline-Ms"] = str(deadline_ms)
     req = urllib.request.Request(
         f"{url}/v1/stream/{sid}?tier={tier}", data=buf.getvalue(),
-        method="POST", headers={"Content-Type": "application/x-npz"})
+        method="POST", headers=headers)
     with urllib.request.urlopen(req, timeout=600) as resp:
         return {
             "status": resp.status,
@@ -187,6 +197,84 @@ def main() -> int:
         finally:
             server.shutdown()
 
+    # ---- multi-stream leg (round 19): warm-h + EDF coalescing --------
+    import threading
+
+    n_streams = 4
+    stream_frames = frames[:5]              # the coherent prefix only
+    serve_cfg2 = ServeConfig(
+        max_batch=4, batch_sizes=(1, 2, 4), iters=ITERS_CAP,
+        sessions=True, session_hidden=True, session_ttl_s=600.0,
+        scene_cut_threshold=40.0, edf_scheduler=True,
+        edf_max_slack_ms=50.0,
+        tiers=(tier, "quality"), default_tier="quality")
+    with StereoService(cfg, variables, serve_cfg2) as svc2:
+        server = StereoHTTPServer(svc2, port=0).start()
+        url = server.url
+        try:
+            health = json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=60).read())
+            assert health["session_hidden"] and health["edf_scheduler"], \
+                health
+            results2 = {j: [] for j in range(n_streams)}
+            errors = []
+            barrier = threading.Barrier(n_streams)
+
+            def stream(j):
+                try:
+                    barrier.wait()
+                    for left, right in stream_frames:
+                        results2[j].append(_post_frame(
+                            url, f"cam{j}", left, right, "stream",
+                            deadline_ms=60000))
+                except Exception as e:  # pragma: no cover - diagnostics
+                    errors.append((j, e))
+
+            d0 = svc2.metrics.batches.value
+            threads = [threading.Thread(target=stream, args=(j,),
+                                        daemon=True)
+                       for j in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=900)
+            assert not errors, errors
+            dispatches = svc2.metrics.batches.value - d0
+            frames_total = n_streams * len(stream_frames)
+            # The coalescing assertion: concurrent sessions' frames
+            # merged into batch-N dispatches — deliberately, via the
+            # EDF bounded-slack wait, not by accident.
+            assert dispatches < frames_total, (
+                f"EDF coalescing must issue fewer dispatches than "
+                f"frames: {dispatches} dispatches for {frames_total} "
+                f"frames")
+            coalescing = frames_total / dispatches
+            multi = sum(svc2.metrics.dispatches_at(n) for n in (2, 4))
+            assert multi >= 1, \
+                "at least one batch>1 dispatch must have occurred"
+            # /metrics carries the evidence the assertion used.
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=60) as resp:
+                metrics2 = resp.read().decode()
+            assert "serve_edf_slack_waits_total" in metrics2
+            assert 'serve_dispatches_total{batch="2"}' in metrics2 \
+                or 'serve_dispatches_total{batch="4"}' in metrics2, \
+                "batch>1 dispatch families missing from /metrics"
+            # warm-h frames must converge at least as fast as the
+            # flow-only leg's warm frames (the hidden trajectory can
+            # only help): compare mean warm iters across the legs.
+            warm_h_iters = [r["iters_used"]
+                            for js in results2.values() for r in js
+                            if r["warm"]]
+            assert warm_h_iters, "multi-stream leg produced no warm frames"
+            mean_warm_h = float(np.mean(warm_h_iters))
+            mean_warm_flow = float(np.mean(warm_iters))
+            assert mean_warm_h <= mean_warm_flow + 1e-9, (
+                f"warm-h frames must use <= warm-flow-only GRU "
+                f"iterations: {mean_warm_h} vs {mean_warm_flow}")
+        finally:
+            server.shutdown()
+
         rec = bench_record({
             "metric": "stream_ci_smoke",
             "value": round(float(np.mean(warm_iters)) / f0["iters_used"],
@@ -200,6 +288,18 @@ def main() -> int:
             "scene_cut_iters": cut["iters_used"],
             "tier": tier,
             "session_stats": stats,
+            # Round-19 multi-stream leg: both asserted properties,
+            # recorded so the artifact is auditable.
+            "multi_stream": {
+                "streams": n_streams,
+                "frames_total": frames_total,
+                "dispatches": int(dispatches),
+                "coalescing_ratio": round(coalescing, 3),
+                "edf_slack_waits":
+                    svc2.metrics.edf_slack_waits.value,
+                "mean_warm_h_iters": round(mean_warm_h, 3),
+                "mean_warm_flow_iters": round(mean_warm_flow, 3),
+            },
         })
     print(json.dumps(rec))
     write_record(OUT, rec, indent=1)

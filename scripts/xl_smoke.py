@@ -16,7 +16,10 @@ The acceptance path of round 17, wired through REAL HTTP:
    measured ``X-Seam-EPE``;
 3. the xl metrics are present in ``/metrics``
    (serve_xl_dispatches_total, serve_xl_hbm_bytes, serve_tile_seam_epe,
-   serve_tiled_requests_total) and /healthz reports the tier topology.
+   serve_tiled_requests_total) and /healthz reports the tier topology;
+4. (r17 follow-up, round 19) a staged burst of xl requests dispatches
+   an xl batch>1 rung — the compiled-but-unbenched ladder is proven to
+   actually run (``serve_dispatches_total{batch="2"}``).
 
 Writes ``XL_ci.json`` (set XL_CI_OUT; CI uploads it).  Exit 0 on
 success, non-zero with a diagnostic on any failed assertion.
@@ -83,7 +86,7 @@ def main() -> int:
     svc = StereoService(cfg, variables, ServeConfig(
         iters=1, cost_telemetry=True,
         xl_mesh="rows=4", xl_threshold_pixels=20_000,
-        xl_max_pixels=40_000,
+        xl_max_pixels=40_000, xl_batch_sizes=(1, 2),
         tile_threshold_pixels=40_000, tile_rows=256, tile_halo=32))
     assert svc.xl_enabled, "8 virtual devices must supply a rows=4 mesh"
     server = StereoHTTPServer(svc, port=0).start()
@@ -146,6 +149,28 @@ def main() -> int:
             url + "/healthz", timeout=60).read())
         assert health["xl"] and health["xl"]["label"] == "rows4"
 
+        # --- 4. xl batch>1 rung actually dispatches under a burst -----
+        # Stage two xl requests with the queue paused, release: the xl
+        # worker's single pop takes the batch-2 bucket (the compiled-
+        # but-unbenched r17 ladder, now proven live).
+        b2_before = svc.metrics.dispatches_at(2)
+        svc.queue.pause()
+        futs = [svc.submit(left, right) for _ in range(2)]
+        svc.queue.resume()
+        burst = [f.result(timeout=1200) for f in futs]
+        assert all(r.tier == "xl" for r in burst)
+        assert svc.metrics.dispatches_at(2) == b2_before + 1, (
+            f"a staged burst of 2 xl requests must dispatch ONE "
+            f"batch-2 xl bucket, dispatches_at(2)="
+            f"{svc.metrics.dispatches_at(2)} (before {b2_before})")
+        assert all(r.batch_size == 2 for r in burst)
+        b2_err = float(np.abs(burst[0].flow - solo_flow).max())
+        assert b2_err < 5e-3, \
+            f"xl batch-2 vs solo max|diff| {b2_err:.2e} >= 5e-3"
+        metrics = urllib.request.urlopen(url + "/metrics",
+                                         timeout=60).read().decode()
+        assert 'serve_dispatches_total{batch="2"}' in metrics
+
         rec = bench_record({
             "metric": "xl_smoke",
             "xl_bucket": f"{XL_HW[0]}x{XL_HW[1]}",
@@ -162,6 +187,10 @@ def main() -> int:
             "tiled_bucket": f"{TILE_HW[0]}x{TILE_HW[1]}",
             "tiles": tiles,
             "seam_epe_px": float(seam),
+            # The r17-follow-up burst leg: one staged batch-2 xl
+            # dispatch must have occurred (asserted above).
+            "xl_batch2_dispatches": svc.metrics.dispatches_at(2),
+            "xl_batch2_vs_solo_max_abs_px": round(b2_err, 8),
             "wall_s": round(time.perf_counter() - t_start, 1),
         })
         with open(OUT, "w") as f:

@@ -1,0 +1,256 @@
+"""EDF cross-session frame scheduler tests (round 19, tier-1, no JAX).
+
+The deadline-aware pop policy (serving/batcher.py ``edf=True``) is pure
+queue logic, so every contract here runs in milliseconds:
+
+* EDF ordering — deadline-carrying requests pop earliest-deadline-first
+  (not FIFO), while expired ones still triage-drop exactly as before;
+* bounded slack — a coalescing wait never extends past the nearest
+  deadline minus the bucket's measured dispatch latency, and never more
+  than ``edf_max_slack_s`` past the head frame's arrival;
+* deliberate coalescing — concurrent sessions' frames merge into the
+  largest fillable batch instead of an idle worker instantly
+  dispatching batch-1;
+* no starvation — deadline-less requests sort by their (past) enqueue
+  stamp, so a flood of future-deadline stream frames can never starve
+  plain traffic;
+* policy-off pin — ``edf=False`` (the default) is the exact r11
+  continuous-batching pop: same results, no latency_fn consultation,
+  no waiting.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from raft_stereo_tpu.serving.batcher import (BucketQueue, DeadlineExceeded,
+                                             Request, edf_key,
+                                             edf_slack_end)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _req(bucket=(64, 64), t_enqueue=0.0, deadline=None, tier=None,
+         family=None):
+    return Request(bucket=bucket, payload=None, future=Future(),
+                   t_enqueue=t_enqueue, deadline=deadline, tier=tier,
+                   family=family)
+
+
+# ------------------------------------------------------------- pure helpers
+def test_edf_key_orders_deadlines_and_enqueue_stamps():
+    a = _req(t_enqueue=10.0, deadline=20.0)
+    b = _req(t_enqueue=11.0, deadline=15.0)
+    plain = _req(t_enqueue=12.0)          # deadline-less
+    assert edf_key(b) < edf_key(a)
+    # a deadline-less request's key is its (past) enqueue stamp — it
+    # sorts ahead of every live (future) deadline
+    assert edf_key(plain) < edf_key(b)
+
+
+def test_edf_slack_end_never_exceeds_nearest_deadline_minus_latency():
+    now = 100.0
+    reqs = [_req(t_enqueue=99.0, deadline=100.5),
+            _req(t_enqueue=99.5, deadline=100.2)]
+    # generous max slack: the deadline bound must win
+    end = edf_slack_end(reqs, now, max_slack_s=10.0, est_latency_s=0.1)
+    assert end == pytest.approx(100.2 - 0.1)
+    assert end <= min(r.deadline for r in reqs)
+    # the measured dispatch latency is always reserved
+    for est in (0.0, 0.05, 0.19):
+        end = edf_slack_end(reqs, now, 10.0, est)
+        assert end <= 100.2 - est
+
+
+def test_edf_slack_end_caps_at_head_age_plus_max_slack():
+    now = 100.0
+    reqs = [_req(t_enqueue=99.98, deadline=200.0)]
+    # far deadline: the max-slack anchor (head enqueue + slack) wins,
+    # and it is ABSOLUTE — re-evaluating at a later "now" converges
+    end = edf_slack_end(reqs, now, max_slack_s=0.05, est_latency_s=0.0)
+    assert end == pytest.approx(99.98 + 0.05)
+    assert edf_slack_end(reqs, 100.02, 0.05, 0.0) == pytest.approx(end)
+
+
+def test_edf_slack_end_no_deadlines_means_no_wait():
+    now = 50.0
+    reqs = [_req(t_enqueue=49.0), _req(t_enqueue=49.5)]
+    assert edf_slack_end(reqs, now, 10.0, 0.0) == now
+
+
+# ------------------------------------------------------------ EDF ordering
+def test_edf_pop_orders_earliest_deadline_first():
+    clock = FakeClock()
+    q = BucketQueue(max_batch=1, batch_sizes=(1,), clock=clock, edf=True,
+                    edf_max_slack_s=0.0)
+    # same group, deadlines submitted OUT of order
+    late = _req(t_enqueue=clock.t, deadline=clock.t + 9.0)
+    soon = _req(t_enqueue=clock.t + 0.001, deadline=clock.t + 1.0)
+    mid = _req(t_enqueue=clock.t + 0.002, deadline=clock.t + 5.0)
+    for r in (late, soon, mid):
+        q.submit(r)
+    order = [q.pop(timeout=1.0)[0] for _ in range(3)]
+    assert order == [soon, mid, late], "EDF must reorder by deadline"
+
+
+def test_edf_expired_requests_still_triage_drop():
+    clock = FakeClock()
+    q = BucketQueue(max_batch=2, batch_sizes=(1, 2), clock=clock,
+                    edf=True, edf_max_slack_s=0.0)
+    dead = _req(t_enqueue=clock.t - 2.0, deadline=clock.t - 1.0)
+    live = _req(t_enqueue=clock.t, deadline=clock.t + 10.0)
+    q.submit(dead)
+    q.submit(live)
+    batch = q.pop(timeout=1.0)
+    assert batch == [live]
+    with pytest.raises(DeadlineExceeded):
+        dead.future.result(timeout=1.0)
+    assert q.metrics.deadline_missed.value == 1
+
+
+def test_edf_no_starvation_of_deadline_less_behind_stream_flood():
+    clock = FakeClock()
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), clock=clock,
+                    edf=True, edf_max_slack_s=0.0)
+    plain = _req(bucket=(32, 32), t_enqueue=clock.t)
+    q.submit(plain)
+    # a flood of deadline-carrying frames in ANOTHER group, all with
+    # future deadlines
+    flood = [_req(bucket=(64, 64), t_enqueue=clock.t + 0.001 * i,
+                  deadline=clock.t + 0.5 + 0.001 * i)
+             for i in range(8)]
+    for r in flood:
+        q.submit(r)
+    first = q.pop(timeout=1.0)
+    assert first == [plain], \
+        "the deadline-less request must pop first (its enqueue stamp " \
+        "is in the past; the flood's deadlines are in the future)"
+
+
+# ------------------------------------------------------ bounded-slack wait
+def test_edf_pop_waits_slack_and_coalesces_into_larger_batch():
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), edf=True,
+                    edf_max_slack_s=10.0)   # deadline bound governs
+    now = time.monotonic()
+    q.submit(_req(t_enqueue=now, deadline=now + 0.25))
+    got = []
+    done = threading.Event()
+
+    def worker():
+        got.append(q.pop(timeout=5.0))
+        done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    # the pop is slack-waiting on the single queued frame; feed three
+    # more from "other sessions" — filling the largest batch size must
+    # release it immediately (no need to run out the slack)
+    time.sleep(0.05)
+    assert not done.is_set(), "pop must hold open during the slack"
+    for i in range(3):
+        q.submit(_req(t_enqueue=time.monotonic(),
+                      deadline=now + 0.25 + 0.01 * i))
+    assert done.wait(2.0)
+    assert len(got[0]) == 4, \
+        f"4 concurrent frames must coalesce into one batch-4 pop, " \
+        f"got {len(got[0])}"
+    assert q.metrics.edf_slack_waits.value >= 1
+    q.close()
+
+
+def test_edf_slack_expiry_dispatches_partial_batch():
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), edf=True,
+                    edf_max_slack_s=0.05)
+    now = time.monotonic()
+    q.submit(_req(t_enqueue=now, deadline=now + 10.0))
+    t0 = time.monotonic()
+    batch = q.pop(timeout=5.0)
+    waited = time.monotonic() - t0
+    assert len(batch) == 1
+    # waited roughly the slack, then dispatched — and NEVER anywhere
+    # near the 10 s deadline
+    assert 0.02 <= waited <= 1.0, waited
+    q.close()
+
+
+def test_edf_latency_fn_reserves_dispatch_time_before_deadline():
+    # measured dispatch latency 80 ms, deadline 100 ms out, max slack
+    # huge: the wait must end ~20 ms in (deadline - latency), not at
+    # the deadline
+    calls = []
+
+    def latency_fn(group_key, batch_size):
+        calls.append((group_key, batch_size))
+        return 0.08
+
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), edf=True,
+                    edf_max_slack_s=10.0, latency_fn=latency_fn)
+    now = time.monotonic()
+    q.submit(_req(t_enqueue=now, deadline=now + 0.1))
+    t0 = time.monotonic()
+    batch = q.pop(timeout=5.0)
+    waited = time.monotonic() - t0
+    assert len(batch) == 1 and calls
+    assert waited <= 0.09, \
+        f"pop must dispatch ~(deadline - measured latency), waited " \
+        f"{waited * 1e3:.0f} ms"
+    q.close()
+
+
+# ------------------------------------------------------------ policy-off pin
+def test_policy_off_pop_path_pinned():
+    """edf=False (the default) is the r11 pop, byte-for-byte behavior:
+    FIFO-by-head-age group selection, head-k extraction, zero waiting,
+    and the latency hook is never consulted."""
+
+    def poisoned_latency_fn(group_key, batch_size):
+        raise AssertionError("policy-off pop must never consult the "
+                             "latency hook")
+
+    clock = FakeClock()
+    q = BucketQueue(max_batch=2, batch_sizes=(1, 2), clock=clock,
+                    latency_fn=poisoned_latency_fn)
+    assert q.edf is False
+    # deadline-carrying requests in "wrong" deadline order: policy off
+    # must return them FIFO, not EDF, and must not wait
+    a = _req(t_enqueue=clock.t, deadline=clock.t + 9.0)
+    b = _req(t_enqueue=clock.t + 0.001, deadline=clock.t + 1.0)
+    q.submit(a)
+    q.submit(b)
+    t0 = time.monotonic()
+    batch = q.pop(timeout=1.0)
+    assert time.monotonic() - t0 < 0.5
+    assert batch == [a, b], "policy off = head-k FIFO extraction"
+    assert q.metrics.edf_slack_waits.value == 0
+    q.close()
+
+
+def test_edf_respects_want_filter_and_sizes():
+    """The xl worker-class contract survives the EDF policy: a want
+    filter still restricts which groups a pop may take."""
+    clock = FakeClock()
+    q = BucketQueue(max_batch=4, batch_sizes=(1, 2, 4), clock=clock,
+                    edf=True, edf_max_slack_s=0.0)
+    xl = _req(bucket=(512, 512), t_enqueue=clock.t,
+              deadline=clock.t + 1.0, family="xl")
+    solo = _req(bucket=(64, 64), t_enqueue=clock.t + 0.001,
+                deadline=clock.t + 0.5)
+    q.submit(xl)
+    q.submit(solo)
+    batch = q.pop(timeout=1.0, want=lambda k: k[2] == "xl", sizes=(1,))
+    assert batch == [xl]
+    batch = q.pop(timeout=1.0, want=lambda k: k[2] != "xl")
+    assert batch == [solo]
+
+
+def test_edf_config_knob_validation():
+    with pytest.raises(ValueError, match="edf_max_slack_s"):
+        BucketQueue(edf=True, edf_max_slack_s=-1.0)

@@ -699,7 +699,7 @@ def test_poisoned_session_frame_releases_lock_and_next_frame_cold(
 
 
 # ------------------------------------------------- session handoff (round 18)
-def _filled_store(n=6, with_ctx=True):
+def _filled_store(n=6, with_ctx=True, with_hidden=True):
     from raft_stereo_tpu.serving.sessions import SessionStore
 
     store = SessionStore()
@@ -710,7 +710,13 @@ def _filled_store(n=6, with_ctx=True):
             flow_low=rng.standard_normal((8, 12)).astype(np.float32),
             thumb=rng.standard_normal((3, 4)).astype(np.float32),
             bucket=(32, 48), raw_shape=(30, 45),
-            warm=(i % 2 == 0), iters_used=3 + i)
+            warm=(i % 2 == 0), iters_used=3 + i,
+            # the round-19 h-tree rides the v2 codec (three levels,
+            # shrinking like the real per-level GRU states)
+            hidden=(tuple(rng.standard_normal((8 >> l, 12 >> l, 4)
+                                              ).astype(np.float32)
+                          for l in range(3))
+                    if with_hidden and i % 3 != 2 else None))
         if with_ctx and i % 2 == 0:
             sess.ctx = (rng.standard_normal((2, 2)).astype(np.float32),
                         (rng.standard_normal((4,)).astype(np.float32),
@@ -745,6 +751,12 @@ def test_handoff_export_import_round_trip():
             assert np.array_equal(a.ctx[0], b.ctx[0])
             assert np.array_equal(a.ctx[1][0], b.ctx[1][0])
             assert b.ctx[1][1] is None
+        if a.hidden is None:
+            assert b.hidden is None
+        else:
+            assert len(b.hidden) == len(a.hidden)
+            for ha, hb in zip(a.hidden, b.hidden):
+                assert np.array_equal(ha, hb)
 
 
 def test_handoff_corrupt_entry_degrades_to_cold_never_crashes():
@@ -897,3 +909,153 @@ def test_http_stream_handoff_header(tiny_model):
             sb.shutdown()
             a.close()
             b.close()
+
+
+# --------------------------------------- hidden-state warm start (round 19)
+def test_run_stream_hidden_tree_structure_and_chain(tiny_model):
+    """carry_hidden returns one evolved state per GRU level at the
+    level's own geometry; feeding it back runs the warm-h program; a
+    hidden tree without its disparity half is a typed error."""
+    from raft_stereo_tpu.eval.runner import InferenceRunner
+
+    cfg, variables = tiny_model
+    runner = InferenceRunner(cfg, variables, iters=ITERS)
+    left, right = _pair()
+    cold = runner.run_stream(left, right, carry_hidden=True)
+    assert cold.hidden is not None and len(cold.hidden) == cfg.n_gru_layers
+    f = cfg.downsample_factor
+    for l, h in enumerate(cold.hidden):
+        assert h.shape == (64 // (f * 2 ** l), 64 // (f * 2 ** l),
+                           cfg.hidden_dims[l])
+    warm = runner.run_stream(left, right, prev_flow_low=cold.flow_low,
+                             prev_hidden=cold.hidden)
+    assert warm.warm and warm.hidden is not None
+    with pytest.raises(ValueError, match="prev_hidden needs"):
+        runner.run_stream(left, right, prev_hidden=cold.hidden)
+    # the hidden-off program surface is untouched: a plain stream frame
+    # still returns no hidden and stays bitwise-pinned upstream
+    plain = runner.run_stream(left, right)
+    assert plain.hidden is None
+
+
+def test_engine_session_hidden_lifecycle_and_families(tiny_model):
+    """session_hidden=True swaps the session families for their _h
+    variants (prewarm/readyz surface + distinct persist keys), frame 0
+    returns the hidden tree, frame 1 consumes it (warm_hidden), and the
+    state invalidates in lockstep with the flow on a scene cut."""
+    from raft_stereo_tpu.serving import (FAMILY_BASE, FAMILY_STATE_H,
+                                         FAMILY_WARM_H, ServeConfig,
+                                         StereoService)
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=ITERS, sessions=True,
+            session_hidden=True, scene_cut_threshold=40.0,
+            warmup_shapes=((48, 64),), prewarm_on_init=False)) as svc:
+        families = {t[4] for t in svc._warm_target}
+        assert families == {FAMILY_BASE, FAMILY_STATE_H, FAMILY_WARM_H}
+        keys = {svc._disk_key((64, 64), 1, 0, None, fam)
+                for fam in (FAMILY_BASE, FAMILY_STATE_H, FAMILY_WARM_H,
+                            "state", "warm")}
+        assert len(keys) == 5, \
+            "h-family persist keys must not collide with the r14 ones"
+        a = _structured(level=40)
+        f0 = svc.infer_session("s", a, a, timeout=300)
+        assert not f0.warm and not f0.warm_hidden
+        assert f0.hidden is not None and len(f0.hidden) == cfg.n_gru_layers
+        sess = svc.sessions.get("s")
+        assert sess.hidden is not None
+        f1 = svc.infer_session("s", a, a, timeout=300)
+        assert f1.warm and f1.warm_hidden
+        # hard scene cut: cold fallback AND the h-tree re-seeds from the
+        # cut frame (lockstep with the flow state)
+        b = 255 - _structured(level=20)
+        f2 = svc.infer_session("s", b, b, timeout=300)
+        assert f2.scene_cut and not f2.warm and not f2.warm_hidden
+        assert svc.sessions.get("s").hidden is not None  # re-seeded
+        f3 = svc.infer_session("s", b, b, timeout=300)
+        assert f3.warm and f3.warm_hidden
+
+
+def test_session_note_result_drops_hidden_with_flow():
+    """The lockstep rule at the store level: a keyframe-guard reseed
+    (flow_low=None) must drop the hidden tree too — a kept trajectory
+    with a dropped disparity would be exactly the torn warm-h input the
+    engine must never build."""
+    from raft_stereo_tpu.serving.sessions import SessionStore
+
+    store = SessionStore()
+    sess, _ = store.get_or_create("s")
+    h = (np.ones((4, 6, 2), np.float32),)
+    sess.note_result(flow_low=np.zeros((4, 6), np.float32), thumb=None,
+                     bucket=(32, 48), raw_shape=(32, 48), warm=False,
+                     iters_used=None, hidden=h)
+    assert sess.hidden is h
+    sess.note_result(flow_low=None, thumb=None, bucket=(32, 48),
+                     raw_shape=(32, 48), warm=True, iters_used=None,
+                     hidden=h)
+    assert sess.flow_low is None and sess.hidden is None
+
+
+def test_handoff_fingerprint_mismatch_refused_typed():
+    """The r18 follow-up: a blob stamped with another exec-config
+    fingerprint is refused wholesale at import — every session counts
+    skipped, none installs."""
+    from raft_stereo_tpu.serving.sessions import (SessionStore,
+                                                  handoff_fingerprint)
+
+    src = _filled_store(n=3)
+    blob = src.export(config_fingerprint="aa" * 32)
+    assert handoff_fingerprint(blob) == "aa" * 32
+    dst = SessionStore()
+    imported, skipped = dst.import_(blob, expect_fingerprint="bb" * 32)
+    assert (imported, skipped) == (0, 3)
+    assert dst.active_count == 0
+    # matching fingerprint imports normally
+    imported, skipped = dst.import_(blob, expect_fingerprint="aa" * 32)
+    assert imported == 3
+    # an UNSTAMPED blob (fingerprint None) is not refused — there is
+    # nothing to compare; per-entry checksums still guard the payload
+    blob2 = _filled_store(n=2).export()
+    dst2 = SessionStore()
+    assert dst2.import_(blob2, expect_fingerprint="bb" * 32)[0] == 2
+
+
+@pytest.mark.slow
+def test_engine_handoff_config_mismatch_cold_starts_typed(tiny_model):
+    """Engine-level config-fingerprint gate: an inheritor compiled at a
+    different depth cap refuses the artifact with the typed
+    serve_handoff_import_skipped_total{reason="config_mismatch"} and
+    the frame cold-starts (never a wrong-geometry warm dispatch).
+    Slow tier for the tier-1 wall budget: the fingerprint REFUSAL
+    contract itself is pinned in tier-1 by the store-level
+    test_handoff_fingerprint_mismatch_refused_typed (no JAX); this adds
+    the engine wiring (two compiled engines), which the metric check in
+    the engine smoke also exercises."""
+    import tempfile
+
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with tempfile.TemporaryDirectory() as store_dir:
+        a_cfg = ServeConfig(max_batch=1, batch_sizes=(1,), iters=1,
+                            sessions=True, session_hidden=True,
+                            executable_cache_dir=store_dir)
+        b_cfg = ServeConfig(max_batch=1, batch_sizes=(1,), iters=2,
+                            sessions=True, session_hidden=True,
+                            executable_cache_dir=store_dir)
+        with StereoService(cfg, variables, a_cfg) as a:
+            a.infer_session("cam", left, right, timeout=300)
+            a.begin_shutdown()
+            manifest = a.publish_handoff()
+            assert manifest["config_fingerprint"] == \
+                a.exec_config_fingerprint()
+        with StereoService(cfg, variables, b_cfg) as b:
+            assert b.exec_config_fingerprint() != \
+                manifest["config_fingerprint"]
+            fb = b.infer_session("cam", left, right, timeout=300,
+                                 handoff_key=manifest["artifact"])
+            assert not fb.warm and fb.frame_index == 0
+            assert b.metrics.handoff_skips("config_mismatch") == 1
+            assert b.metrics.sessions_adopted.value == 0
