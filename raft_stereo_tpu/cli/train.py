@@ -52,6 +52,11 @@ def configs_from_args(args):
         data_parallel=args.data_parallel,
         gru_telemetry=args.gru_telemetry,
         trace_sample_rate=args.trace_sample_rate,
+        anomaly_policy=args.anomaly_policy,
+        anomaly_spike_factor=args.anomaly_spike_factor,
+        anomaly_rewind_after=args.anomaly_rewind_after,
+        anomaly_max_rewinds=args.anomaly_max_rewinds,
+        checkpoint_keep=args.checkpoint_keep,
     )
     return model_cfg, train_cfg
 
@@ -157,6 +162,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alarm (anomaly event + flight-recorder bundle) "
                         "when no step completes within 10x the rolling "
                         "median step time")
+    # Divergence-proof training (training/anomaly.py; docs/architecture.md
+    # §Training resilience) — off by default: the step program and loop
+    # are byte-identical to the pre-policy path then.
+    p.add_argument("--anomaly_policy", action="store_true",
+                   help="drop non-finite (and, with --anomaly_spike_factor, "
+                        "loss-spike) updates ON DEVICE and rewind to the "
+                        "newest good checkpoint after K consecutive "
+                        "anomalies, reshuffling the remaining epoch order")
+    p.add_argument("--anomaly_spike_factor", type=float, default=0.0,
+                   help="also drop a finite loss above this factor x the "
+                        "device-side loss EWMA (0 = non-finite only)")
+    p.add_argument("--anomaly_rewind_after", type=int, default=3,
+                   help="consecutive dropped steps that trigger a "
+                        "checkpoint rewind (0 = skip-only)")
+    p.add_argument("--anomaly_max_rewinds", type=int, default=2,
+                   help="rewinds allowed before the run fails typed "
+                        "(TrainingDiverged)")
+    p.add_argument("--checkpoint_keep", type=int, default=0,
+                   help="keep-last-K retention for periodic checkpoints "
+                        "(0 = keep all; the newest GOOD-stamped rewind "
+                        "target is never pruned)")
     p.add_argument("--flight_recorder_dir", default=None,
                    help="debug-bundle directory for the flight recorder "
                         "(spans + events ring, /metrics snapshot, stack "

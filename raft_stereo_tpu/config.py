@@ -505,6 +505,31 @@ class TrainConfig:
     seed: int = 1234
     # Parallelism: devices along the data axis; 0 = all available.
     data_parallel: int = 0
+    # --- Divergence-proof training (round 20, training/anomaly.py) ---
+    # Master switch for the anomaly policy: the jitted step gains an
+    # on-device skip gate (non-finite loss/grads — and loss spikes when
+    # anomaly_spike_factor > 0 — leave params/optimizer/step untouched,
+    # flagged through the buffered metric drain, zero extra host syncs)
+    # and the loop rewinds to the newest GOOD checkpoint after
+    # anomaly_rewind_after CONSECUTIVE dropped steps, reshuffling the
+    # remaining epoch order so the poison batch is not replayed.  Off
+    # (default) keeps the step program and loop byte-identical to the
+    # pre-round-20 path.
+    anomaly_policy: bool = False
+    # Drop a finite loss above spike_factor x the device-side loss EWMA
+    # (0 = non-finite only).  The EWMA is threaded through the step like
+    # the train state and checkpointed, so resume keeps the baseline.
+    anomaly_spike_factor: float = 0.0
+    anomaly_ewma_beta: float = 0.98
+    # Consecutive dropped steps that trigger a checkpoint rewind
+    # (0 = skip-only, never rewind).
+    anomaly_rewind_after: int = 3
+    # Rewinds allowed before the run fails typed (TrainingDiverged).
+    anomaly_max_rewinds: int = 2
+    # Keep-last-K retention for periodic <step>_<name> checkpoints
+    # (0 = keep all).  The newest GOOD-stamped checkpoint is never
+    # pruned — it is the rewind target.
+    checkpoint_keep: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

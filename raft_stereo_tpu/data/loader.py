@@ -9,18 +9,56 @@ is NumPy/cv2 releasing the GIL; the native C++ decode path slots in below.
 Determinism: the epoch-``e`` permutation comes from ``seed + e`` and each
 sample's augmentation RNG from ``(seed, epoch, index)`` (see datasets.py), so
 a (seed, step) pair maps to one exact batch regardless of thread scheduling.
+
+Round 20 (divergence-proof training) adds two production contracts:
+
+* **Fault isolation** — a sample whose decode RAISES is retried once
+  (transient I/O) and then QUARANTINED: a deterministic substitute sample
+  fills its batch slot, the index joins a persisted quarantine list
+  (``quarantine_path``), and typed counters (``stats``) expose every
+  decision.  A dead process worker (OOM-killed, segfaulted decoder) is
+  respawned and its in-flight batches resubmitted — one corrupt shard or
+  one killed worker no longer ends a week-long run.
+* **Exact-resume state** — ``state()``/``set_state()`` round-trip the
+  loader position as a flat batch OFFSET (``epoch * len(self) + batch``)
+  plus the rewind reshuffle SALTS: a salt event ``(epoch, batch, salt)``
+  re-permutes the REMAINDER of that epoch's order (consumed prefix
+  untouched, no sample repeats), which is how a checkpoint rewind avoids
+  deterministically replaying the poison batch.  Both live in the
+  checkpoint runtime blob (training/checkpoint.py), making a preempted
+  run's data order bitwise identical to an uninterrupted one.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import pickle
 import queue
 import threading
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from raft_stereo_tpu.data.datasets import StereoDataset
+
+log = logging.getLogger(__name__)
+
+# One retry before quarantine: transient NFS hiccups succeed on the second
+# read; a truly corrupt sample fails twice and is pulled from rotation.
+SAMPLE_RETRIES = 1
+
+# A worker pool that breaks this many times consecutively is not going to
+# heal by respawning (e.g. the dataset itself segfaults every decode).
+MAX_POOL_RESPAWNS = 3
+
+
+class LoaderBroken(RuntimeError):
+    """Typed terminal loader failure: the worker pool kept dying after
+    ``MAX_POOL_RESPAWNS`` consecutive respawns — respawning is not going
+    to converge, a human needs to look at the dataset/host."""
+
 
 def _collate(dataset: StereoDataset, epoch: int, indices
              ) -> Dict[str, np.ndarray]:
@@ -30,20 +68,89 @@ def _collate(dataset: StereoDataset, epoch: int, indices
     return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
 
 
+def _substitute_index(i: int, n: int, quarantined) -> int:
+    """Deterministic replacement for a quarantined sample: the next
+    non-quarantined index (wrapping).  Pure function of (i, n, quarantine
+    set), so every worker flavor picks the same substitute."""
+    for k in range(1, n):
+        j = (i + k) % n
+        if j not in quarantined:
+            return j
+    raise LoaderBroken(f"all {n} dataset samples quarantined")
+
+
+def _collate_isolated(dataset: StereoDataset, epoch: int, indices,
+                      quarantined=frozenset(),
+                      retries: int = SAMPLE_RETRIES
+                      ) -> Tuple[Dict[str, np.ndarray], List[Dict]]:
+    """``_collate`` with per-sample fault isolation.
+
+    Returns ``(batch, events)``: each raising sample is retried
+    ``retries`` times, then replaced by its deterministic substitute and
+    reported as a ``quarantined`` event (a retry that SUCCEEDS reports
+    ``retried``).  Already-quarantined indices substitute immediately.
+    Events flow back to the owning loader (any worker flavor), which
+    merges them into the shared quarantine set + typed counters.
+    """
+    events: List[Dict] = []
+    samples = []
+    n = len(dataset)
+    for i in indices:
+        i = int(i)
+        use = i
+        if use in quarantined:
+            use = _substitute_index(use, n, quarantined)
+        sample = None
+        local_quarantine = set(quarantined)
+        while sample is None:
+            try:
+                sample = dataset.__getitem__(use, epoch)
+            except Exception as e:
+                retried = False
+                for _ in range(retries):
+                    try:
+                        sample = dataset.__getitem__(use, epoch)
+                        retried = True
+                        break
+                    except Exception:
+                        continue
+                if retried:
+                    events.append({"kind": "retried", "index": use,
+                                   "error": repr(e)})
+                    break
+                events.append({"kind": "quarantined", "index": use,
+                               "error": repr(e)})
+                local_quarantine.add(use)
+                use = _substitute_index(use, n, local_quarantine)
+        samples.append(sample)
+    return ({k: np.stack([s[k] for s in samples]) for k in samples[0]},
+            events)
+
+
 # --------------------------------------------------- process-worker plumbing
 # Module-level so child processes (spawn) can import it; the dataset is
 # shipped once via the pool initializer, not per task.
 _WORKER_DATASET: Optional[StereoDataset] = None
+_WORKER_QUARANTINE: set = set()
 
 
-def _process_worker_init(ds_bytes: bytes) -> None:
-    global _WORKER_DATASET
+def _process_worker_init(ds_bytes: bytes, quarantined=()) -> None:
+    global _WORKER_DATASET, _WORKER_QUARANTINE
     _WORKER_DATASET = pickle.loads(ds_bytes)
+    _WORKER_QUARANTINE = set(quarantined)
 
 
 def _process_make_batch(args):
     epoch, indices = args
-    return _collate(_WORKER_DATASET, epoch, indices)
+    batch, events = _collate_isolated(_WORKER_DATASET, epoch, indices,
+                                      quarantined=_WORKER_QUARANTINE)
+    # Keep the worker-local view current so later batches in THIS worker
+    # substitute immediately; the parent merges events into the shared
+    # set and ships it to fresh workers at (re)spawn.
+    for ev in events:
+        if ev["kind"] == "quarantined":
+            _WORKER_QUARANTINE.add(ev["index"])
+    return batch, events
 
 
 class StereoLoader:
@@ -61,6 +168,12 @@ class StereoLoader:
         slice of each global batch (``parallel.distributed`` supplies these;
         ``mesh.shard_batch`` reassembles the global array).  Yielded batches
         then have ``batch_size // process_count`` samples.
+      quarantine_path: JSON file persisting quarantined sample indices
+        across restarts (None = in-memory only); loaded at construction,
+        rewritten on every new quarantine.
+      fault_isolation: retry-once-then-quarantine raising samples and
+        respawn dead process workers (default on).  Off = a raising
+        sample propagates to the consumer (the pre-round-20 behavior).
     """
 
     def __init__(self, dataset: StereoDataset, batch_size: int,
@@ -68,7 +181,9 @@ class StereoLoader:
                  prefetch: int = 2, seed: int = 1234,
                  epochs: Optional[int] = None,
                  process_index: int = 0, process_count: int = 1,
-                 worker_type: str = "thread"):
+                 worker_type: str = "thread",
+                 quarantine_path: Optional[str] = None,
+                 fault_isolation: bool = True):
         if len(dataset) < batch_size:
             raise ValueError(
                 f"dataset has {len(dataset)} samples < batch_size={batch_size}")
@@ -102,19 +217,115 @@ class StereoLoader:
         # without an ``if __name__ == "__main__"`` guard re-executes that
         # script in every worker.
         self.worker_type = worker_type
+        self.fault_isolation = fault_isolation
+        self.quarantine_path = quarantine_path
+        # Shared fault state: guarded by _fault_lock (thread workers write
+        # concurrently); counters are the typed telemetry surface the
+        # train loop mirrors into train_loader_* instruments.
+        self._fault_lock = threading.Lock()
+        self.quarantined: set = set()
+        self.stats: Dict[str, int] = {"retried": 0, "quarantined": 0,
+                                      "worker_respawns": 0}
+        if quarantine_path and os.path.exists(quarantine_path):
+            try:
+                with open(quarantine_path) as f:
+                    self.quarantined = set(
+                        int(i) for i in json.load(f).get("indices", []))
+                log.info("loaded %d quarantined sample indices from %s",
+                         len(self.quarantined), quarantine_path)
+            except (OSError, ValueError, TypeError):
+                log.warning("unreadable quarantine file %s; starting empty",
+                            quarantine_path)
+        # Exact-resume position: the NEXT batch yielded by a fresh
+        # iterator is global batch offset ``start_offset`` (epoch =
+        # offset // len(self), batch = offset % len(self)); ``salts``
+        # are the rewind reshuffle events (epoch, batch, salt).
+        self.start_offset = 0
+        self.salts: Tuple[Tuple[int, int, int], ...] = ()
 
     def __len__(self) -> int:
         return len(self.dataset) // self.batch_size  # drop_last
 
+    # ------------------------------------------------------- resume state
+    def state(self, consumed: int = 0) -> Dict[str, Any]:
+        """Serializable position after ``consumed`` batches of the current
+        iterator: feed to ``set_state`` (or the checkpoint runtime blob)
+        to resume with a bitwise-identical data order."""
+        return {"offset": self.start_offset + consumed,
+                "salts": [list(s) for s in self.salts]}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        """Position the NEXT ``iter()`` at ``state`` (a ``state()`` dict).
+        Live iterators are unaffected — the train loop closes its
+        prefetcher and re-iterates after calling this."""
+        self.start_offset = int(state.get("offset", 0))
+        self.salts = tuple((int(e), int(b), int(s))
+                           for e, b, s in state.get("salts", ()))
+
+    def add_salt(self, epoch: int, batch: int, salt: int) -> None:
+        """Append a rewind reshuffle event: the order of epoch ``epoch``
+        from batch ``batch`` on is re-permuted with ``salt`` (consumed
+        prefix untouched, still no within-epoch sample repeats) — the
+        poison batch that triggered the rewind lands somewhere else."""
+        self.salts = self.salts + ((int(epoch), int(batch), int(salt)),)
+
+    # -------------------------------------------------------- batch order
     def _epoch_order(self, epoch: int) -> np.ndarray:
-        if not self.shuffle:
-            return np.arange(len(self.dataset))
-        return np.random.default_rng(self.seed + epoch).permutation(
-            len(self.dataset))
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(
+                len(self.dataset))
+        else:
+            order = np.arange(len(self.dataset))
+        # Salt events apply in arrival order even with shuffle off — a
+        # rewind must perturb the order either way, that is its point.
+        for e, b, s in self.salts:
+            if e != epoch:
+                continue
+            cut = b * self.batch_size
+            rng = np.random.default_rng([self.seed, epoch, b, s])
+            order = np.concatenate([order[:cut],
+                                    rng.permutation(order[cut:])])
+        return order
 
     def _make_batch(self, epoch: int, indices: np.ndarray
                     ) -> Dict[str, np.ndarray]:
-        return _collate(self.dataset, epoch, indices)
+        if not self.fault_isolation:
+            return _collate(self.dataset, epoch, indices)
+        with self._fault_lock:
+            quarantined = frozenset(self.quarantined)
+        batch, events = _collate_isolated(self.dataset, epoch, indices,
+                                          quarantined=quarantined)
+        self._note_fault_events(events)
+        return batch
+
+    def _note_fault_events(self, events: Sequence[Dict]) -> None:
+        if not events:
+            return
+        dirty = False
+        with self._fault_lock:
+            for ev in events:
+                if ev["kind"] == "retried":
+                    self.stats["retried"] += 1
+                    log.warning("sample %s raised once and succeeded on "
+                                "retry: %s", ev["index"], ev["error"])
+                elif ev["kind"] == "quarantined":
+                    if ev["index"] not in self.quarantined:
+                        self.quarantined.add(ev["index"])
+                        self.stats["quarantined"] += 1
+                        dirty = True
+                    log.warning("sample %s quarantined after retry: %s",
+                                ev["index"], ev["error"])
+            snapshot = sorted(self.quarantined)
+        if dirty and self.quarantine_path:
+            try:
+                tmp = f"{self.quarantine_path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"indices": snapshot}, f)
+                    f.write("\n")
+                os.replace(tmp, self.quarantine_path)
+            except OSError:  # pragma: no cover - unwritable quarantine dir
+                log.warning("could not persist quarantine list to %s",
+                            self.quarantine_path)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if self.num_workers <= 0:
@@ -127,24 +338,21 @@ class StereoLoader:
     def _batch_indices(self):
         local = self.batch_size // self.process_count
         lo = self.process_index * local
-        epoch = 0
+        epoch, start_batch = divmod(self.start_offset, max(1, len(self)))
         while self.epochs is None or epoch < self.epochs:
             order = self._epoch_order(epoch)
-            for i in range(len(self)):
+            for i in range(start_batch, len(self)):
                 global_slice = order[i * self.batch_size:
                                      (i + 1) * self.batch_size]
                 yield epoch, global_slice[lo:lo + local]
+            start_batch = 0
             epoch += 1
 
     def _iter_sync(self):
         for epoch, idx in self._batch_indices():
             yield self._make_batch(epoch, idx)
 
-    def _iter_process(self):
-        """Spawned worker processes; submission order = yield order (an
-        ordered deque of futures doubles as the reorder buffer), with at
-        most ``prefetch + num_workers`` batches in flight."""
-        import collections
+    def _spawn_pool(self):
         import concurrent.futures as cf
         import multiprocessing as mp
 
@@ -152,14 +360,34 @@ class StereoLoader:
         # internal threads/locks must not be duplicated into children
         ctx = mp.get_context("spawn")
         ds_bytes = pickle.dumps(self.dataset)
-        max_ahead = self.prefetch + self.num_workers
-        pool = cf.ProcessPoolExecutor(self.num_workers, mp_context=ctx,
+        with self._fault_lock:
+            quarantined = tuple(sorted(self.quarantined))
+        return cf.ProcessPoolExecutor(self.num_workers, mp_context=ctx,
                                       initializer=_process_worker_init,
-                                      initargs=(ds_bytes,))
+                                      initargs=(ds_bytes, quarantined))
+
+    def _iter_process(self):
+        """Spawned worker processes; submission order = yield order (an
+        ordered deque of futures doubles as the reorder buffer), with at
+        most ``prefetch + num_workers`` batches in flight.
+
+        A BROKEN pool (a worker process died: OOM kill, native decoder
+        segfault) is respawned with the current quarantine view and every
+        in-flight batch resubmitted in order — the consumer never sees
+        the death, only the ``worker_respawns`` counter moving.  After
+        ``MAX_POOL_RESPAWNS`` consecutive breakages the loader raises the
+        typed ``LoaderBroken`` instead of respawn-looping forever."""
+        import collections
+
+        max_ahead = self.prefetch + self.num_workers
+        pool = self._spawn_pool()
         try:
             gen = self._batch_indices()
+            # Each entry rides (future, args) so a broken pool can
+            # resubmit the exact same work to the fresh one.
             inflight: "collections.deque" = collections.deque()
             exhausted = False
+            respawns_in_a_row = 0
             while True:
                 while not exhausted and len(inflight) < max_ahead:
                     try:
@@ -167,11 +395,46 @@ class StereoLoader:
                     except StopIteration:
                         exhausted = True
                         break
-                    inflight.append(pool.submit(_process_make_batch,
-                                                (epoch, idx)))
+                    args = (epoch, idx)
+                    inflight.append(
+                        (pool.submit(_process_make_batch, args), args))
                 if not inflight:
                     return
-                yield inflight.popleft().result()
+                fut, args = inflight.popleft()
+                try:
+                    result = fut.result()
+                except BaseException as e:
+                    if not (self.fault_isolation
+                            and _is_broken_pool_error(e)):
+                        raise
+                    respawns_in_a_row += 1
+                    with self._fault_lock:
+                        self.stats["worker_respawns"] += 1
+                    log.warning(
+                        "loader worker pool died (%r); respawn %d/%d and "
+                        "resubmitting %d in-flight batches", e,
+                        respawns_in_a_row, MAX_POOL_RESPAWNS,
+                        len(inflight) + 1)
+                    if respawns_in_a_row > MAX_POOL_RESPAWNS:
+                        raise LoaderBroken(
+                            f"worker pool died {respawns_in_a_row} times "
+                            f"in a row; last error: {e!r}") from e
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    pool = self._spawn_pool()
+                    redo = [args] + [a for _, a in inflight]
+                    inflight.clear()
+                    for a in redo:
+                        inflight.append(
+                            (pool.submit(_process_make_batch, a), a))
+                    continue
+                respawns_in_a_row = 0
+                if (isinstance(result, tuple) and len(result) == 2
+                        and isinstance(result[1], list)):
+                    batch, events = result
+                    self._note_fault_events(events)
+                else:   # fault_isolation=False workers return bare batches
+                    batch = result
+                yield batch
         finally:
             # Early close (consumer break / GeneratorExit) must not sit
             # through prefetch+num_workers queued full-frame batches — drop
@@ -242,3 +505,16 @@ class StereoLoader:
             # risking a hang on a stuck decode.
             for t in threads:
                 t.join(timeout=2.0)
+
+
+def _is_broken_pool_error(e: BaseException) -> bool:
+    """Whether an exception out of ``Future.result()`` means the POOL
+    died (worker process killed) rather than the task raising.  Task
+    exceptions cannot occur with fault isolation on — ``_collate_isolated``
+    absorbs them — so a raising future is pool death by construction;
+    the isinstance check keeps non-isolated semantics exact."""
+    import concurrent.futures as cf
+
+    broken = (getattr(cf.process, "BrokenProcessPool", None),
+              cf.BrokenExecutor)
+    return isinstance(e, tuple(b for b in broken if b is not None))

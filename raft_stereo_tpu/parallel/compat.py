@@ -50,3 +50,64 @@ def pcast_varying(x, axis):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis,), to="varying")
     return x
+
+
+# ------------------------------------------------------- capability probes
+# jax 0.4.x's CPU backend cannot lower a PARTIAL-manual shard_map on a
+# multi-axis mesh: the old-API translation (auto= axes) emits a
+# PartitionId instruction the CPU SPMD partitioner rejects
+# ("UNIMPLEMENTED: PartitionId instruction is not supported ...") — or,
+# earlier in lowering, a bare NotImplementedError.  That is exactly the
+# corr-mesh composition (W2-sharded volume / rows trunk sharing a mesh
+# with another axis; ROADMAP item 2): the rows-only meshes run fine
+# through compat.shard_map, the two-axis ones need TPU.  This probe runs
+# the minimal two-axis partial-manual program ONCE per process and gives
+# tests a typed skip reason, so a known-environment failure reads as a
+# visible capability skip instead of pre-existing red — without losing
+# any signal on backends (TPU) where the probe passes.
+
+CORR_MESH_UNSUPPORTED = "corr_mesh_unsupported"
+_partial_manual_probe = None
+
+
+def partial_manual_mesh_capability():
+    """``(ok, reason)`` — whether this backend runs a partial-manual
+    shard_map over a two-axis mesh.  ``reason`` is typed: it starts with
+    ``corr_mesh_unsupported:`` when the probe failed (the skip string),
+    and is ``""`` when supported.  Cached for the process lifetime."""
+    global _partial_manual_probe
+    if _partial_manual_probe is not None:
+        return _partial_manual_probe
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        _partial_manual_probe = (
+            False, f"{CORR_MESH_UNSUPPORTED}: needs >= 4 devices for a "
+            f"two-axis mesh, have {len(devices)}")
+        return _partial_manual_probe
+    try:
+        # The minimal failing construct on jax 0.4.x CPU: lax.axis_index
+        # inside a PARTIAL-manual shard_map lowers to a PartitionId the
+        # CPU SPMD partitioner rejects (a bare psum passes; ppermute
+        # aborts the whole process with an XLA CHECK failure, so the
+        # probe deliberately uses the exception-raising repro).
+        mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("data", "corr"))
+
+        def body(x):
+            return jax.lax.psum(x + jax.lax.axis_index("corr"), "corr")
+
+        f = shard_map(body, mesh, axis_names=("corr",),
+                      in_specs=P("corr"), out_specs=P())
+        out = jax.jit(f)(np.arange(2, dtype=np.float32))
+        np.asarray(out)   # force execution, not just lowering
+        _partial_manual_probe = (True, "")
+    except Exception as e:  # typed: the skip reason carries the evidence
+        msg = str(e).splitlines()[0] if str(e) else type(e).__name__
+        _partial_manual_probe = (
+            False, f"{CORR_MESH_UNSUPPORTED}: {type(e).__name__}: {msg} "
+            f"(jax {jax.__version__} on "
+            f"{devices[0].platform}; rows-only meshes are the supported "
+            f"path here, corr meshes need TPU — ROADMAP item 2)")
+    return _partial_manual_probe

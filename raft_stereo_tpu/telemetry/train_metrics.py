@@ -209,6 +209,36 @@ class TrainTelemetry:
             "per-iteration |disparity update| means "
             "(TrainConfig.gru_telemetry; empty when disabled)",
             buckets=GRU_DELTA_BUCKETS)
+        # --- Divergence-proof training (round 20, training/anomaly.py):
+        # every anomaly-policy decision lands in a TYPED counter — the
+        # chaos matrix (tools/train_chaos.py) asserts zero silent skips.
+        skip_help = ("optimizer updates dropped on device by the anomaly "
+                     "policy (TrainConfig.anomaly_policy)")
+        self.batches_skipped = {
+            "nonfinite": r.counter("train_batches_skipped_total", skip_help,
+                                   labels={"reason": "nonfinite"}),
+            "spike": r.counter("train_batches_skipped_total", skip_help,
+                               labels={"reason": "spike"})}
+        self.rewinds = r.counter(
+            "train_rewinds_total",
+            "checkpoint rewinds after consecutive anomalous steps")
+        self.checkpoints_rejected = r.counter(
+            "train_checkpoints_rejected_total",
+            "checkpoints skipped at restore for failing validation "
+            "(torn, or SHA-256 manifest mismatch — bit rot / byte flip)")
+        self.loader_retries = r.counter(
+            "train_loader_sample_retries_total",
+            "samples that raised once and decoded on retry")
+        self.loader_quarantined = r.counter(
+            "train_loader_samples_quarantined_total",
+            "samples quarantined after a failed retry (substituted "
+            "deterministically; persisted to the quarantine list)")
+        self.loader_respawns = r.counter(
+            "train_loader_worker_respawns_total",
+            "dead loader worker pools respawned (in-flight batches "
+            "resubmitted)")
+        self._loader_stats_seen = {"retried": 0, "quarantined": 0,
+                                   "worker_respawns": 0}
 
         self._lock = threading.Lock()
         self._status = "starting"
@@ -363,6 +393,44 @@ class TrainTelemetry:
         host — the drained ``gru_delta_px`` metric vector."""
         for d in deltas:
             self.gru_delta.observe(float(d))
+
+    # ------------------------------------------- anomaly-policy mirrors
+    def observe_anomaly_skip(self, step: int, kind: str) -> None:
+        """One on-device-dropped update, as drained by the loop (kind is
+        ``nonfinite`` or ``spike``)."""
+        counter = self.batches_skipped.get(kind)
+        if counter is not None:
+            counter.inc()
+        if self.events is not None:
+            self.events.emit("skip_batch", step=step, reason=kind)
+
+    def observe_rewind(self, from_step: int, to_step: int,
+                       checkpoint: str) -> None:
+        """A checkpoint rewind: anomaly event (+ flight-recorder bundle
+        when wired) plus the typed counter."""
+        self.rewinds.inc()
+        self.anomaly_sink.fire("training_rewind", from_step=from_step,
+                               to_step=to_step, checkpoint=checkpoint)
+
+    def observe_checkpoint_rejected(self, path: str, reason: str) -> None:
+        self.checkpoints_rejected.inc()
+        if self.events is not None:
+            self.events.emit("checkpoint_rejected", path=path,
+                             reason=reason)
+
+    def observe_loader_stats(self, stats: Dict[str, int]) -> None:
+        """Mirror the loader's cumulative fault counters (StereoLoader
+        .stats) into the registry; called at the drain cadence, deltas
+        computed here so the loader stays telemetry-free."""
+        mapping = (("retried", self.loader_retries),
+                   ("quarantined", self.loader_quarantined),
+                   ("worker_respawns", self.loader_respawns))
+        for key, counter in mapping:
+            now = int(stats.get(key, 0))
+            delta = now - self._loader_stats_seen[key]
+            if delta > 0:
+                counter.inc(delta)
+            self._loader_stats_seen[key] = now
 
     def observe_checkpoint(self, seconds: float, path: str,
                            step: int) -> None:

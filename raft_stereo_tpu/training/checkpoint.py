@@ -25,10 +25,11 @@ older non-atomic writers), resume from the newest step that validates.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,6 +42,14 @@ log = logging.getLogger(__name__)
 CONFIG_FILE = "config.json"
 STATE_DIR = "state"
 COMMIT_FILE = "COMMIT"   # written last; marks the checkpoint complete
+# Round 20 (divergence-proof training): per-file SHA-256 integrity
+# manifest, the loop-runtime sidecar (host RNG + loader position + anomaly
+# history — the exact-resume state the orbax tree cannot carry), and the
+# GOOD stamp (written only after a post-restore validation probe passed;
+# the rewind target).
+MANIFEST_FILE = "MANIFEST"
+RUNTIME_FILE = "runtime.json"
+GOOD_FILE = "GOOD"
 
 
 # ---------------------------------------------------------------- migration
@@ -118,12 +127,42 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_files(root: str) -> List[str]:
+    """Every regular file under ``root`` except the manifest/commit pair
+    (relative paths, sorted — the manifest's hash domain)."""
+    out: List[str] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            if rel in (MANIFEST_FILE, COMMIT_FILE):
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
 def save_checkpoint(path: str, model_cfg: RaftStereoConfig,
-                    state_tree: Dict[str, Any]) -> None:
+                    state_tree: Dict[str, Any],
+                    runtime_state: Optional[Dict[str, Any]] = None) -> None:
     """Save ``state_tree`` (any pytree of arrays) + the model config,
     ATOMICALLY: stage into ``<path>.tmp-<pid>``, fsync, mark ``COMMIT``,
     then ``os.replace`` into place.  A crash at any point leaves the
-    previous checkpoint (or nothing) at ``path`` — never a torn one."""
+    previous checkpoint (or nothing) at ``path`` — never a torn one.
+
+    ``runtime_state`` (optional, JSON-serializable) is the train loop's
+    exact-resume sidecar: host RNG state, loader position + reshuffle
+    salts, anomaly history, loss EWMA — everything a bitwise resume needs
+    that is not a device array.  Every staged file is hashed into
+    ``MANIFEST`` (SHA-256) and the ``COMMIT`` marker seals the manifest's
+    own hash, so a flipped byte ANYWHERE in the blob is detectable
+    (``is_valid_checkpoint(deep=True)``) instead of restoring garbage."""
     path = _abs(path)
     parent = os.path.dirname(path) or "."
     os.makedirs(parent, exist_ok=True)
@@ -137,16 +176,38 @@ def save_checkpoint(path: str, model_cfg: RaftStereoConfig,
             f.write(model_cfg.to_json())
             f.flush()
             os.fsync(f.fileno())
+        if runtime_state is not None:
+            with open(os.path.join(tmp, RUNTIME_FILE), "w") as f:
+                json.dump(runtime_state, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.join(tmp, STATE_DIR),
                    jax.device_get(state_tree), force=True)
         ckptr.wait_until_finished()
-        commit: Dict[str, Any] = {"complete": True}
+        step: Optional[int] = None
         if "step" in state_tree:   # lets latest_checkpoint rank without
             try:                   # restoring the whole state tree
-                commit["step"] = int(np.asarray(state_tree["step"]))
+                step = int(np.asarray(state_tree["step"]))
             except (TypeError, ValueError):
                 pass
+        manifest: Dict[str, Any] = {
+            "files": {rel: _file_sha256(os.path.join(tmp, rel))
+                      for rel in _manifest_files(tmp)}}
+        if step is not None:
+            manifest["step"] = step
+        manifest_path = os.path.join(tmp, MANIFEST_FILE)
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        commit: Dict[str, Any] = {
+            "complete": True,
+            "manifest_sha256": _file_sha256(manifest_path)}
+        if step is not None:
+            commit["step"] = step
         with open(os.path.join(tmp, COMMIT_FILE), "w") as f:
             json.dump(commit, f)
             f.write("\n")
@@ -176,12 +237,65 @@ def save_checkpoint(path: str, model_cfg: RaftStereoConfig,
         raise
 
 
-def is_valid_checkpoint(path: str) -> bool:
+def verify_manifest(path: str) -> Tuple[bool, str]:
+    """Deep integrity check: the ``COMMIT`` marker must seal the
+    ``MANIFEST``'s hash and every manifest entry must hash to its
+    recorded SHA-256.  Returns ``(ok, reason)``; checkpoints written
+    before the manifest existed return ``(True, "legacy_no_manifest")``
+    — there is nothing to verify against, and shallow validation keeps
+    covering them."""
+    path = _abs(path)
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    commit_path = os.path.join(path, COMMIT_FILE)
+    if not os.path.exists(manifest_path):
+        if os.path.exists(commit_path):
+            try:
+                with open(commit_path) as f:
+                    commit = json.load(f)
+            except (OSError, ValueError):
+                return False, "commit_unreadable"
+            if "manifest_sha256" in commit:
+                return False, "manifest_missing"
+        return True, "legacy_no_manifest"
+    try:
+        with open(commit_path) as f:
+            commit = json.load(f)
+        sealed = commit["manifest_sha256"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return False, "commit_unreadable"
+    if _file_sha256(manifest_path) != sealed:
+        return False, "manifest_hash_mismatch"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        files = dict(manifest["files"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return False, "manifest_unreadable"
+    for rel, want in files.items():
+        full = os.path.join(path, rel)
+        try:
+            got = _file_sha256(full)
+        except OSError:
+            return False, f"missing_file:{rel}"
+        if got != want:
+            return False, f"hash_mismatch:{rel}"
+    # Files present but not in the manifest are tolerated (the GOOD
+    # stamp is written post-save by design).
+    return True, "ok"
+
+
+def is_valid_checkpoint(path: str, deep: bool = False) -> bool:
     """Whether ``path`` holds a complete checkpoint: parseable
     ``config.json`` + a non-empty orbax state dir.  The ``COMMIT`` marker
     is required only when absent TOGETHER with a suspicious state — all
     checkpoints written by the atomic saver carry it; pre-round-13
-    checkpoints (no marker, but intact files) still validate."""
+    checkpoints (no marker, but intact files) still validate.
+
+    ``deep=True`` additionally verifies the round-20 SHA-256 manifest
+    (``verify_manifest``): a single flipped byte anywhere in the blob
+    fails validation instead of restoring garbage.  Legacy checkpoints
+    without a manifest pass deep validation at the shallow level (nothing
+    recorded to verify against)."""
     path = _abs(path)
     state = os.path.join(path, STATE_DIR)
     try:
@@ -194,11 +308,53 @@ def is_valid_checkpoint(path: str) -> bool:
             return False
     except OSError:
         return False
+    if deep:
+        ok, reason = verify_manifest(path)
+        if not ok:
+            log.warning("checkpoint %s failed deep validation: %s",
+                        path, reason)
+            return False
     return True
 
 
+def checkpoint_step(path: str) -> int:
+    """The step a checkpoint records (-1 when unrecorded): manifest first,
+    then the COMMIT marker, then the legacy ``<step>_<name>`` dir name."""
+    path = _abs(path)
+    for meta in (MANIFEST_FILE, COMMIT_FILE):
+        try:
+            with open(os.path.join(path, meta)) as f:
+                step = json.load(f).get("step")
+            if step is not None:
+                return int(step)
+        except (OSError, ValueError, TypeError):
+            continue
+    prefix = os.path.basename(path).split("_", 1)[0]
+    return int(prefix) if prefix.isdigit() else -1
+
+
+def _run_entries(root: str, name: Optional[str]) -> List[str]:
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return []
+    out = []
+    for entry in entries:
+        if ".tmp-" in entry or ".old-" in entry:
+            continue
+        if name is not None and not (entry == name
+                                     or entry.endswith(f"_{name}")):
+            continue
+        if os.path.isdir(os.path.join(root, entry)):
+            out.append(entry)
+    return out
+
+
 def latest_checkpoint(checkpoint_dir: str,
-                      name: Optional[str] = None) -> Optional[str]:
+                      name: Optional[str] = None,
+                      deep: bool = False,
+                      on_reject: Optional[Callable[[str, str], None]] = None
+                      ) -> Optional[str]:
     """The newest VALID checkpoint under ``checkpoint_dir``, or None.
 
     The train loop writes ``<step>_<name>`` per validation boundary plus
@@ -208,35 +364,103 @@ def latest_checkpoint(checkpoint_dir: str,
     resume-from-latest-valid: a preemption mid-save costs at most the
     steps since the previous checkpoint, never a crash loop on a torn
     directory.  ``name`` (optional) restricts to that run's checkpoints.
+    ``deep=True`` verifies the SHA-256 manifest of every candidate, so a
+    bit-flipped blob falls back to the newest checkpoint that still
+    verifies; ``on_reject(path, reason)`` (optional) is called for every
+    candidate rejected — the loop wires a typed telemetry counter there.
     """
     root = _abs(checkpoint_dir)
-    try:
-        entries = sorted(os.listdir(root))
-    except OSError:
-        return None
     best: Optional[str] = None
     best_key = (-1, -1.0)
-    for entry in entries:
-        if ".tmp-" in entry or ".old-" in entry:
-            continue
-        if name is not None and not (entry == name
-                                     or entry.endswith(f"_{name}")):
-            continue
+    for entry in _run_entries(root, name):
         path = os.path.join(root, entry)
-        if not os.path.isdir(path) or not is_valid_checkpoint(path):
+        if not is_valid_checkpoint(path, deep=deep):
+            if on_reject is not None:
+                reason = "invalid"
+                if deep:
+                    ok, why = verify_manifest(path)
+                    reason = why if not ok else "invalid"
+                on_reject(path, reason)
             continue
-        step = -1
-        try:   # the atomic saver records the step in the COMMIT marker
-            with open(os.path.join(path, COMMIT_FILE)) as f:
-                step = int(json.load(f).get("step", -1))
-        except (OSError, ValueError, TypeError):
-            step_prefix = entry.split("_", 1)[0]   # legacy: dir name
-            if step_prefix.isdigit():
-                step = int(step_prefix)
-        key = (step, os.path.getmtime(path))
+        key = (checkpoint_step(path), os.path.getmtime(path))
         if key > best_key:
             best, best_key = path, key
     return best
+
+
+def valid_checkpoints(checkpoint_dir: str, name: Optional[str] = None,
+                      deep: bool = True) -> List[str]:
+    """All valid checkpoints for ``name``, newest step first — the rewind
+    candidate list (training/anomaly.py): the loop probes them in order
+    and restores the first that passes."""
+    root = _abs(checkpoint_dir)
+    found = []
+    for entry in _run_entries(root, name):
+        path = os.path.join(root, entry)
+        if is_valid_checkpoint(path, deep=deep):
+            found.append((checkpoint_step(path), os.path.getmtime(path),
+                          path))
+    return [p for _, _, p in sorted(found, reverse=True)]
+
+
+def load_runtime_state(path: str) -> Optional[Dict[str, Any]]:
+    """The loop-runtime sidecar saved alongside the state tree (loader
+    position, host RNG, anomaly history), or None on checkpoints saved
+    without one (pre-round-20, or weights-only exports)."""
+    try:
+        with open(os.path.join(_abs(path), RUNTIME_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ------------------------------------------------------ GOOD stamp + prune
+def mark_good(path: str) -> None:
+    """Stamp a checkpoint GOOD — written only after the post-restore
+    validation probe passed (train_loop._probe_state): restored params
+    and optimizer state are finite.  The stamp is advisory metadata
+    written AFTER the atomic commit (it is not part of the manifest);
+    rewind prefers stamped checkpoints but re-probes either way."""
+    try:
+        with open(os.path.join(_abs(path), GOOD_FILE), "w") as f:
+            f.write("{}\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:  # pragma: no cover - read-only checkpoint dir
+        log.warning("could not stamp GOOD on %s", path)
+
+
+def is_good(path: str) -> bool:
+    return os.path.exists(os.path.join(_abs(path), GOOD_FILE))
+
+
+def prune_checkpoints(checkpoint_dir: str, name: Optional[str] = None,
+                      keep: int = 3) -> List[str]:
+    """Keep-last-K retention over the periodic ``<step>_<name>``
+    checkpoints (the final/preemption ``<name>`` checkpoint and the
+    newest GOOD-stamped checkpoint are never pruned — the latter is the
+    rewind target).  Returns the removed paths."""
+    import shutil
+
+    if keep <= 0:
+        return []
+    root = _abs(checkpoint_dir)
+    ranked = []
+    for entry in _run_entries(root, name):
+        if name is not None and entry == name:
+            continue   # the final/preemption checkpoint is not periodic
+        path = os.path.join(root, entry)
+        ranked.append((checkpoint_step(path), os.path.getmtime(path), path))
+    ranked.sort(reverse=True)
+    newest_good = next((p for _, _, p in ranked if is_good(p)), None)
+    removed = []
+    for _, _, path in ranked[keep:]:
+        if path == newest_good:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+        log.info("pruned checkpoint %s (keep-last-%d)", path, keep)
+    return removed
 
 
 def load_config(path: str) -> RaftStereoConfig:

@@ -23,6 +23,8 @@ from raft_stereo_tpu.data.device_jitter import (JitterParams,
                                                 apply_photometric,
                                                 params_for_datasets)
 from raft_stereo_tpu.parallel.mesh import DATA_AXIS
+from raft_stereo_tpu.training.anomaly import (SKIP_KEY, SKIP_NONFINITE_KEY,
+                                              SKIP_SPIKE_KEY, AnomalyPolicy)
 from raft_stereo_tpu.training.loss import sequence_loss
 from raft_stereo_tpu.training.state import TrainState
 
@@ -89,22 +91,89 @@ def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
     return new_state, metrics
 
 
+def anomaly_train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
+                       loss_ewma: jnp.ndarray, *, iters: int,
+                       loss_gamma: float, max_flow: float,
+                       policy: AnomalyPolicy,
+                       jitter: Optional[JitterParams] = None,
+                       jitter_seed: int = 0,
+                       gru_telemetry: bool = False):
+    """``train_step`` wrapped in the on-device anomaly gate.
+
+    The forward/backward is the plain step's; the update is then merged
+    through ``jnp.where``: a non-finite loss or grad norm — or, when
+    ``policy.spike_factor > 0``, a finite loss above ``spike_factor ×``
+    the device-side loss EWMA — keeps EVERY leaf of the old state
+    (params, optimizer moments, step counter), so a poison batch is a
+    no-op update instead of a poisoned run.  ``loss_ewma`` is a device
+    f32 scalar the loop threads step-to-step (0 = no baseline yet; the
+    first finite loss seeds it), checkpointed in the runtime blob so an
+    exact resume keeps the spike baseline bitwise.  The skip decision and
+    flags stay on device and reach the host through the existing
+    buffered metric drain — zero extra syncs (the r13 contract).
+    """
+    new_state, metrics = train_step(
+        state, batch, iters=iters, loss_gamma=loss_gamma, max_flow=max_flow,
+        jitter=jitter, jitter_seed=jitter_seed, gru_telemetry=gru_telemetry)
+    loss = metrics["loss"]
+    grad_norm = metrics["grad_norm"]
+    nonfinite = jnp.logical_not(jnp.logical_and(jnp.isfinite(loss),
+                                                jnp.isfinite(grad_norm)))
+    if policy.spike_factor > 0:
+        spike = jnp.logical_and(
+            jnp.logical_not(nonfinite),
+            jnp.logical_and(loss_ewma > 0,
+                            loss > loss_ewma * policy.spike_factor))
+    else:
+        spike = jnp.zeros((), jnp.bool_)
+    skip = jnp.logical_or(nonfinite, spike)
+    # where() selects, never mixes: a NaN in the discarded branch cannot
+    # leak (no arithmetic with it), and the kept branch is bit-identical
+    # to whichever state survives.
+    merged = jax.tree_util.tree_map(
+        lambda old, new: jnp.where(skip, old, new), state, new_state)
+    beta = policy.ewma_beta
+    updated_ewma = jnp.where(loss_ewma > 0,
+                             beta * loss_ewma + (1.0 - beta) * loss,
+                             loss)
+    new_ewma = jnp.where(skip, loss_ewma, updated_ewma)
+    f32 = jnp.float32
+    metrics = dict(metrics, **{
+        SKIP_KEY: skip.astype(f32),
+        SKIP_NONFINITE_KEY: nonfinite.astype(f32),
+        SKIP_SPIKE_KEY: spike.astype(f32)})
+    return merged, metrics, new_ewma
+
+
 def make_train_step(train_cfg: TrainConfig, mesh: Optional[Mesh] = None,
-                    donate: bool = True):
+                    donate: bool = True,
+                    anomaly: Optional[AnomalyPolicy] = None):
     """Compile the step.  With a ``mesh``, the batch is sharded along
     ``data`` and the state replicated; XLA derives the gradient all-reduce
     (psum over ICI) from the shardings — the SPMD replacement for
-    ``nn.DataParallel`` (reference: train_stereo.py:134)."""
+    ``nn.DataParallel`` (reference: train_stereo.py:134).
+
+    ``anomaly=None`` (default) compiles the exact pre-round-20 two-arg
+    program; with an ``AnomalyPolicy`` the step signature becomes
+    ``(state, batch, loss_ewma) -> (state, metrics, loss_ewma)`` with the
+    on-device skip gate of ``anomaly_train_step``."""
     jitter = None
     if train_cfg.device_photometric:
         jitter = params_for_datasets(train_cfg.train_datasets,
                                      saturation_range=train_cfg.saturation_range,
                                      img_gamma=train_cfg.img_gamma)
-    step = functools.partial(train_step, iters=train_cfg.train_iters,
-                             loss_gamma=train_cfg.loss_gamma,
-                             max_flow=train_cfg.max_flow,
-                             jitter=jitter, jitter_seed=train_cfg.seed,
-                             gru_telemetry=train_cfg.gru_telemetry)
+    common = dict(iters=train_cfg.train_iters,
+                  loss_gamma=train_cfg.loss_gamma,
+                  max_flow=train_cfg.max_flow,
+                  jitter=jitter, jitter_seed=train_cfg.seed,
+                  gru_telemetry=train_cfg.gru_telemetry)
+    if anomaly is not None:
+        step = functools.partial(anomaly_train_step, policy=anomaly,
+                                 **common)
+        n_out = 3
+    else:
+        step = functools.partial(train_step, **common)
+        n_out = 2
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
@@ -112,7 +181,7 @@ def make_train_step(train_cfg: TrainConfig, mesh: Optional[Mesh] = None,
     data = NamedSharding(mesh, P(DATA_AXIS))
     return jax.jit(
         step,
-        in_shardings=(repl, data),
-        out_shardings=(repl, repl),
+        in_shardings=(repl, data) + ((repl,) if n_out == 3 else ()),
+        out_shardings=(repl,) * n_out,
         donate_argnums=(0,) if donate else (),
     )

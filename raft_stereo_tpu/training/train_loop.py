@@ -23,6 +23,7 @@ import time
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from raft_stereo_tpu.config import RaftStereoConfig, TrainConfig
@@ -32,6 +33,8 @@ from raft_stereo_tpu.parallel import distributed
 from raft_stereo_tpu.parallel.corr_sharded import corr_sharding
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicate, shard_batch
 from raft_stereo_tpu.training import checkpoint as ckpt
+from raft_stereo_tpu.training.anomaly import (AnomalyPolicy, AnomalyTracker,
+                                              TrainingDiverged)
 from raft_stereo_tpu.training.logger import Logger, SUM_FREQ
 from raft_stereo_tpu.training.optimizer import make_optimizer
 from raft_stereo_tpu.training.state import TrainState, create_train_state
@@ -85,15 +88,23 @@ class _DevicePrefetcher:
 
     The wrapped iterator's exceptions re-raise in the consumer; exhaustion
     yields the usual StopIteration so ``next(it, None)`` keeps feeding the
-    train loop's global stop collective."""
+    train loop's global stop collective.  The producer's terminal state
+    (exhausted or crashed) is REMEMBERED: the queue sentinel is delivered
+    exactly once, so a consumer that keeps calling ``__next__`` after the
+    worker thread died re-raises the same terminal condition immediately
+    instead of blocking forever on a queue nothing will ever feed again
+    (the pre-round-20 hang: one crashed upload wedged the loop's next
+    ``next(batches, None)``)."""
 
     _DONE = object()
 
     def __init__(self, it, put, depth: int = _DEVICE_PREFETCH_DEPTH):
         import queue
 
+        self._it = it
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        self._terminal: Optional[object] = None   # _DONE or BaseException
 
         def run():
             try:
@@ -113,10 +124,18 @@ class _DevicePrefetcher:
         return self
 
     def __next__(self):
+        if self._terminal is not None:
+            # The producer is gone; its sentinel was already consumed.
+            # Blocking on the queue here would hang forever.
+            if self._terminal is self._DONE:
+                raise StopIteration
+            raise self._terminal  # type: ignore[misc]
         item = self._q.get()
         if item is self._DONE:
+            self._terminal = item
             raise StopIteration
         if isinstance(item, BaseException):
+            self._terminal = item
             raise item
         return item
 
@@ -124,7 +143,9 @@ class _DevicePrefetcher:
         self._stop.set()
         # unblock a producer waiting on a full queue, then wait for it to
         # leave the JAX runtime — a daemon thread still inside device_put at
-        # interpreter teardown crashes the process exit.  Bounded: if the
+        # interpreter teardown crashes the process exit.  A producer that
+        # already CRASHED (terminal exception delivered) is dead; the drain
+        # loop is skipped and join returns immediately.  Bounded: if the
         # producer wedges inside device_put/shard_batch (plausible behind a
         # remote device tunnel) we abandon the daemon thread with a warning
         # instead of spinning train()'s finally block forever.
@@ -136,6 +157,18 @@ class _DevicePrefetcher:
                 except Exception:  # pragma: no cover - raced drain
                     break
             self._thread.join(timeout=0.2)
+        if not self._thread.is_alive():
+            # Release the underlying generator's worker threads/pools NOW
+            # (the rewind path re-iterates the same loader; waiting for GC
+            # would leak a thread pool per rewind).  Safe only once the
+            # producer thread left the generator frame.
+            close_it = getattr(self._it, "close", None)
+            if close_it is not None:
+                try:
+                    close_it()
+                except Exception:  # pragma: no cover - raced teardown
+                    log.debug("loader iterator close raised", exc_info=True)
+            return
         if self._thread.is_alive():  # pragma: no cover - wedged upload
             # Abandon the daemon thread so train()'s finally block cannot
             # spin forever — but give it one last bounded join at interpreter
@@ -247,8 +280,16 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         # check, training/checkpoint.py).  A preemption mid-save can
         # never leave a torn checkpoint at a final name, and anything
         # torn by an older writer is skipped instead of crash-looping
-        # the restart.
-        restore = ckpt.latest_checkpoint(checkpoint_dir, name=name)
+        # the restart.  deep=True verifies the SHA-256 manifest: a
+        # bit-flipped blob (bad disk, torn copy) falls back to the
+        # newest checkpoint that still verifies, typed (counter + log)
+        # instead of restoring garbage.
+        def _reject(path, reason):
+            log.warning("skipping corrupt checkpoint %s (%s)", path, reason)
+            if telemetry is not None:
+                telemetry.observe_checkpoint_rejected(path, reason)
+        restore = ckpt.latest_checkpoint(checkpoint_dir, name=name,
+                                         deep=True, on_reject=_reject)
         if restore is None:
             log.warning("--restore_ckpt latest: no valid checkpoint "
                         "under %s for run %r; starting fresh",
@@ -257,6 +298,7 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             log.info("--restore_ckpt latest resolved to %s", restore)
 
     start_step = 0
+    runtime: Optional[Dict] = None   # round-20 exact-resume sidecar
     if restore and restore.endswith(".pth"):
         # warm start from a reference torch checkpoint
         from raft_stereo_tpu.io.torch_import import import_torch_checkpoint
@@ -281,11 +323,29 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         state = create_train_state(model_cfg, train_cfg, rng, init_shape)
         model_cfg, restored = ckpt.load_checkpoint(
             restore, target=_arrays_of(state))
+        # step goes back as a weak-typed scalar (int(...)): the live
+        # TrainState's step aval is weak int32, and a non-weak restored
+        # array would silently recompile the step executable.
         state = state.replace(params=restored["params"],
                               batch_stats=restored["batch_stats"],
                               opt_state=restored["opt_state"],
-                              step=restored["step"])
+                              step=jnp.asarray(int(np.asarray(
+                                  restored["step"]))))
         start_step = int(restored["step"])
+        # Round 20: the runtime sidecar restores what the array tree
+        # cannot — loop step (skipped updates make it run ahead of the
+        # device step counter), loader position + reshuffle salts, host
+        # RNG, anomaly history, loss EWMA — so a preempt+resume run is
+        # bitwise identical to an uninterrupted one, data order included.
+        runtime = ckpt.load_runtime_state(restore)
+        if runtime:
+            start_step = int(runtime.get("loop_step", start_step))
+            _set_host_rng(runtime.get("host_rng"))
+        # The post-restore validation probe: finite params/opt state =>
+        # this checkpoint is stamped GOOD (the rewind target contract —
+        # a checkpoint is only known-good once a restore of it passed).
+        if _finite_state(restored):
+            ckpt.mark_good(restore)
         log.info("exact resume from %s at step %d", restore, start_step)
     else:
         state = create_train_state(model_cfg, train_cfg, rng, init_shape)
@@ -297,7 +357,19 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         mixture = build_training_mixture(train_cfg, data_root)
         loader = StereoLoader(mixture, batch_size=train_cfg.batch_size,
                               seed=train_cfg.seed,
+                              quarantine_path=os.path.join(
+                                  checkpoint_dir,
+                                  f"{name}.quarantine.json"),
                               **distributed.loader_shard_kwargs())
+    # Fast-forward the loader to the checkpointed position (a no-op
+    # without a runtime sidecar: legacy checkpoints keep the old
+    # restart-at-epoch-0 behavior).  set_state is duck-typed so test
+    # loaders without resume support still work.
+    if runtime and runtime.get("loader") is not None:
+        set_state = getattr(loader, "set_state", None)
+        if set_state is not None:
+            set_state(runtime["loader"])
+            log.info("loader resumed at %s", runtime["loader"])
     # Adapt the validation hook's arity ONCE, before the loop: a legacy
     # one-arg validate_fn(variables) must not TypeError hours in at the
     # first validation boundary.
@@ -313,7 +385,17 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         else:
             run_validation = validate_fn
 
-    step_fn = make_train_step(train_cfg, mesh=mesh)
+    # Divergence-proof runtime (round 20, training/anomaly.py): with the
+    # policy on, the step gains the on-device skip gate and threads the
+    # loss EWMA; the tracker below turns drained skip flags into rewind
+    # decisions.  Policy off (default) compiles the exact two-arg step.
+    policy = AnomalyPolicy.from_train_config(train_cfg)
+    tracker = AnomalyTracker(policy) if policy is not None else None
+    if tracker is not None and runtime:
+        tracker.load_history(runtime.get("anomaly"))
+    loss_ewma = float(runtime.get("loss_ewma", 0.0)) if runtime else 0.0
+
+    step_fn = make_train_step(train_cfg, mesh=mesh, anomaly=policy)
     if telemetry is not None and getattr(telemetry, "costs", None) is not None:
         # AOT-instrumented step dispatch (telemetry/costs.py): the first
         # batch lowers + compiles through the cost registry, recording the
@@ -394,6 +476,15 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                           if "gru_delta_px" in m]
             for m, lr in zip(fetched, lrs):
                 logger.push(m, lr=float(lr))
+            if tracker is not None:
+                # The anomaly tracker consumes the drained per-step skip
+                # flags (already host floats — zero extra fetches, the
+                # NonFiniteSentinel contract) and arms the rewind check
+                # the loop runs right after each drain.
+                for offset, m in enumerate(fetched):
+                    kind = tracker.observe(first + offset, m)
+                    if kind is not None and telemetry is not None:
+                        telemetry.observe_anomaly_skip(first + offset, kind)
             if telemetry is not None:
                 means = ({k: float(np.mean([m[k] for m in fetched]))
                           for k in fetched[0]} if fetched else {})
@@ -401,6 +492,8 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                                         means, step, window=len(fetched))
                 for d in gru_deltas:
                     telemetry.observe_gru_deltas(np.asarray(d).ravel())
+                if hasattr(loader, "stats"):
+                    telemetry.observe_loader_stats(loader.stats)
 
         # Host->device upload (or global shard assembly) runs on a prefetch
         # thread, ahead of the step dispatch — the synchronous per-step
@@ -420,6 +513,101 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
         else:
             put = upload
         batches = _DevicePrefetcher(iter(loader), put)
+        # Loader-position bookkeeping for the exact-resume sidecar: the
+        # current iterator started at the loader's own start_offset when
+        # the loop step counter read anchor_step, so the position after
+        # step S is start_offset + (S - anchor_step).
+        anchor_step = start_step
+        ewma_dev = (jnp.asarray(loss_ewma, jnp.float32)
+                    if policy is not None else None)
+
+        def _runtime_blob():
+            blob: Dict = {"loop_step": step, "host_rng": _get_host_rng()}
+            loader_state = getattr(loader, "state", None)
+            if loader_state is not None:
+                blob["loader"] = loader_state(consumed=step - anchor_step)
+            if tracker is not None:
+                blob["anomaly"] = tracker.history()
+            if ewma_dev is not None:
+                blob["loss_ewma"] = float(jax.device_get(ewma_dev))
+            return blob
+
+        def do_rewind():
+            """Restore the newest checkpoint that passes the finite-state
+            probe, reshuffle the remaining epoch order (salt event) so
+            the poison batch is not deterministically replayed, and
+            resume the loop there.  Raises the typed TrainingDiverged
+            when the rewind budget or the checkpoint supply is out."""
+            nonlocal state, step, batches, anchor_step, ewma_dev
+            if not tracker.rewind_budget_left():
+                raise TrainingDiverged(
+                    step, f"{tracker.consecutive} consecutive anomalous "
+                    f"steps and max_rewinds={policy.max_rewinds} exhausted")
+            target = _arrays_of(state)
+            for path in ckpt.valid_checkpoints(checkpoint_dir, name=name,
+                                               deep=True):
+                try:
+                    _, restored = ckpt.load_checkpoint(path, target=target)
+                except Exception:
+                    log.warning("rewind: restore of %s failed; trying "
+                                "older", path, exc_info=True)
+                    continue
+                if not _finite_state(restored):
+                    log.warning("rewind: %s fails the finite-state probe "
+                                "(saved post-divergence?); trying older",
+                                path)
+                    continue
+                ckpt.mark_good(path)   # probe passed => known-good
+                rt = ckpt.load_runtime_state(path) or {}
+                to_step = int(rt.get("loop_step",
+                                     int(np.asarray(restored["step"]))))
+                new_state = state.replace(
+                    params=restored["params"],
+                    batch_stats=restored["batch_stats"],
+                    opt_state=restored["opt_state"],
+                    # weak-typed like the live state's step (see the
+                    # exact-resume branch) — a non-weak aval would
+                    # recompile the step executable after every rewind
+                    step=jnp.asarray(int(np.asarray(restored["step"]))))
+                if mesh is not None:
+                    new_state = replicate(new_state, mesh)
+                else:
+                    # Restored leaves are host numpy arrays; upload them
+                    # now so the resumed dispatch hits the SAME compiled
+                    # executable (a numpy-leaved call re-lowers through
+                    # the AOT instrumentation and reads as a recompile).
+                    new_state = jax.device_put(new_state)
+                from_step = step
+                tracker.note_rewind(from_step, to_step, path)
+                _set_host_rng(rt.get("host_rng"))
+                # Reposition the loader at the checkpoint and add the
+                # reshuffle salt (keyed by the rewind ordinal so repeated
+                # rewinds draw different permutations).
+                if hasattr(loader, "set_state"):
+                    loader.set_state(rt.get("loader")
+                                     or {"offset": to_step, "salts": []})
+                    if hasattr(loader, "add_salt") and len(loader) > 0:
+                        e, b = divmod(loader.start_offset, len(loader))
+                        loader.add_salt(e, b, tracker.rewinds)
+                batches.close()
+                batches = _DevicePrefetcher(iter(loader), put)
+                pending_metrics.clear()
+                state = new_state
+                step = to_step
+                anchor_step = to_step
+                ewma_dev = jnp.asarray(float(rt.get("loss_ewma", 0.0)),
+                                       jnp.float32)
+                log.warning("anomaly rewind %d/%d: step %d -> %d from %s "
+                            "(remaining epoch order reshuffled)",
+                            tracker.rewinds, policy.max_rewinds,
+                            from_step, to_step, path)
+                if telemetry is not None:
+                    telemetry.observe_rewind(from_step, to_step, path)
+                return
+            raise TrainingDiverged(
+                step, "no checkpoint passes the finite-state probe — "
+                "nothing to rewind to")
+
         try:
             while True:
                 # Telemetry timing is gated on ``telemetry is not None`` at
@@ -448,7 +636,11 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                     break
                 if telemetry is not None:
                     telemetry.note_batch(batch)
-                state, metrics = step_fn(state, batch)
+                if policy is not None:
+                    state, metrics, ewma_dev = step_fn(state, batch,
+                                                       ewma_dev)
+                else:
+                    state, metrics = step_fn(state, batch)
                 step += 1
                 if telemetry is not None:
                     # dispatch leg only (async dispatch returns at submit);
@@ -459,13 +651,27 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
                 pending_metrics.append(metrics)
                 if len(pending_metrics) >= SUM_FREQ:
                     drain_metrics()
+                    if tracker is not None and tracker.should_rewind():
+                        do_rewind()
+                        continue
 
                 if (step % train_cfg.validation_frequency == 0
                         or step == total):
                     drain_metrics()
+                    # Rewind decisions come BEFORE the save: K consecutive
+                    # anomalies mean the current state is suspect, and a
+                    # checkpoint of it would poison the rewind ladder.
+                    if tracker is not None and tracker.should_rewind():
+                        do_rewind()
+                        continue
                     save_path = os.path.join(checkpoint_dir,
                                              f"{step}_{name}")
-                    _save(save_path, model_cfg, state, step, telemetry)
+                    _save(save_path, model_cfg, state, step, telemetry,
+                          runtime_state=_runtime_blob())
+                    if train_cfg.checkpoint_keep > 0:
+                        ckpt.prune_checkpoints(
+                            checkpoint_dir, name=name,
+                            keep=train_cfg.checkpoint_keep)
                     if run_validation is not None:
                         variables = {
                             "params": jax.device_get(state.params),
@@ -479,7 +685,7 @@ def _train_impl(model_cfg: RaftStereoConfig, train_cfg: TrainConfig,
             # stop-request handler may still be installed, so a first signal
             # here cannot kill a half-written save.
             _save(os.path.join(checkpoint_dir, name), model_cfg, state,
-                  step, telemetry)
+                  step, telemetry, runtime_state=_runtime_blob())
             run_status = "stopped" if stop_requested else "complete"
         finally:
             # Also on the exception path: a crash at step N must not discard
@@ -516,10 +722,47 @@ def _arrays_of(state: TrainState):
             "step": np.asarray(jax.device_get(state.step))}
 
 
+def _finite_state(tree) -> bool:
+    """The post-restore validation probe: every float leaf of the restored
+    params/opt_state is finite.  A checkpoint saved after divergence (NaN
+    already in the weights or the Adam moments) fails here and the rewind
+    falls through to an older one."""
+    for leaf in jax.tree_util.tree_leaves(
+            {"params": tree.get("params"),
+             "opt_state": tree.get("opt_state")}):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                np.isfinite(arr)):
+            return False
+    return True
+
+
+def _get_host_rng():
+    """The global NumPy RNG state as a JSON-serializable blob (everything
+    seeded explicitly — loader permutations, per-sample augmentation — is
+    already deterministic; this covers any library code drawing from the
+    GLOBAL stream so exact resume reproduces it too)."""
+    name, keys, pos, has_gauss, cached = np.random.get_state()
+    return [name, np.asarray(keys).tolist(), int(pos), int(has_gauss),
+            float(cached)]
+
+
+def _set_host_rng(blob) -> None:
+    if not blob:
+        return
+    try:
+        name, keys, pos, has_gauss, cached = blob
+        np.random.set_state((name, np.asarray(keys, np.uint32), int(pos),
+                             int(has_gauss), float(cached)))
+    except (ValueError, TypeError):  # pragma: no cover - foreign blob
+        log.warning("could not restore host RNG state from checkpoint")
+
+
 def _save(path: str, model_cfg: RaftStereoConfig, state: TrainState,
-          step: int, telemetry=None) -> None:
+          step: int, telemetry=None, runtime_state=None) -> None:
     t0 = time.perf_counter() if telemetry is not None else 0.0
-    ckpt.save_checkpoint(path, model_cfg, _arrays_of(state))
+    ckpt.save_checkpoint(path, model_cfg, _arrays_of(state),
+                         runtime_state=runtime_state)
     log.info("saved checkpoint %s", path)
     if telemetry is not None:
         telemetry.observe_checkpoint(time.perf_counter() - t0, path, step)

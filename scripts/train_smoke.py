@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""CI training-resilience smoke: the round-20 divergence-proof runtime
+under injected faults, fast enough for the tier-1 job.
+
+Runs the fast subset of the tools/train_chaos.py matrix on a tiny
+synthetic model (CPU, no datasets):
+
+1. **rewind** — a contiguous NaN-poison window forces >= 3 consecutive
+   on-device skips: the loop must REWIND to the newest good checkpoint,
+   reshuffle the remaining epoch order, and still run to completion
+   with train_rewinds_total >= 1 (this leg also covers the single
+   NaN-step skip counter).
+2. **raising sample** — a sample that raises on every decode must be
+   retried once, quarantined (typed counter + persisted list), and
+   substituted — the run completes.
+3. **SIGTERM + exact resume** — SIGTERM mid-run checkpoints at the step
+   boundary; the resumed run's final params must be BITWISE equal to an
+   uninterrupted run's (loader position, host RNG, and loss EWMA all
+   restored from the checkpoint runtime sidecar).
+
+Writes the results to RESILIENCE_TRAIN_ci.json (``TRAIN_SMOKE_OUT``)
+with the shared bench_record header.  Exit 0 on success, non-zero with a
+diagnostic on any violation — zero silent skips.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/train_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+OUT = os.environ.get("TRAIN_SMOKE_OUT",
+                     os.path.join(_REPO, "RESILIENCE_TRAIN_ci.json"))
+
+import train_chaos  # noqa: E402  (tools/train_chaos.py)
+
+
+def main() -> int:
+    results = {}
+    failures = []
+    t_start = time.time()
+    baseline_digest = None
+    legs = (("baseline", train_chaos.leg_baseline),
+            ("rewind", train_chaos.leg_rewind),
+            ("raising_sample", train_chaos.leg_raising_sample),
+            ("sigterm_resume",
+             lambda wd: train_chaos.leg_sigterm_resume(wd,
+                                                       baseline_digest)))
+    for name, fn in legs:
+        workdir = tempfile.mkdtemp(prefix=f"train_smoke_{name}_")
+        t0 = time.time()
+        try:
+            rec = fn(workdir)
+            if name == "baseline":
+                baseline_digest = rec["params_sha256"]
+            rec["wall_s"] = round(time.time() - t0, 2)
+            print(f"[train_smoke] {name}: OK {rec}")
+        except BaseException as e:
+            rec = {"completed": False, "error": repr(e)}
+            failures.append(name)
+            print(f"[train_smoke] {name}: FAIL {e!r}")
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        results[name] = rec
+
+    # The acceptance assertions the issue names explicitly: a clean
+    # completion everywhere, a rewind actually counted, and bitwise
+    # preempt+resume.
+    ok = (not failures
+          and results["rewind"].get("count", 0) >= 1
+          and results["sigterm_resume"].get("bitwise_equal") is True)
+
+    from raft_stereo_tpu.telemetry.events import bench_record
+    record = bench_record(
+        {"metric": "train_resilience_smoke", "legs": results,
+         "all_completed": ok,
+         "wall_s": round(time.time() - t_start, 2)},
+        tool="train_smoke")
+    with open(OUT, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[train_smoke] wrote {OUT}")
+    if not ok:
+        print(f"[train_smoke] FAILED: {failures or 'assertions'}")
+        return 1
+    print("[train_smoke] training resilience smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,8 @@ def _coords(rng, b, h, w1, w2):
 @pytest.mark.parametrize("n_corr", [2, 4])
 @pytest.mark.parametrize("w2", [64, 52, 13])
 def test_sharded_matches_reg(rng, n_corr, w2):
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=n_corr)
     mesh = make_mesh(n_data=8 // n_corr, n_corr=n_corr)
     b, h, w1 = 2, 4, 52
@@ -48,6 +50,8 @@ def test_sharded_matches_reg(rng, n_corr, w2):
 
 @pytest.mark.slow
 def test_sharded_gradients_match_reg(rng):
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=2)
     mesh = make_mesh(n_data=4, n_corr=2)
     b, h, w1, w2 = 1, 4, 24, 40
@@ -75,6 +79,8 @@ def test_sharded_reg_fused_backend_matches_reg(rng):
     """corr_w2_shards with the (default) reg_fused backend: the sharded
     volume path must agree with the unsharded reg backend (fp32 inputs ⇒
     fp32 shard storage ⇒ exact)."""
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=2, corr_backend="reg_fused")
     mesh = make_mesh(n_data=4, n_corr=2)
     b, h, w1, w2 = 1, 4, 24, 40
@@ -100,6 +106,8 @@ def test_dispatch_requires_active_mesh(rng):
 @pytest.mark.slow
 def test_full_model_sharded_matches_unsharded(rng):
     """Whole-model forward with corr_w2_shards=2 ≡ the plain reg model."""
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     from raft_stereo_tpu.models.raft_stereo import RAFTStereo
 
     mesh = make_mesh(n_data=4, n_corr=2)
@@ -144,6 +152,8 @@ def test_sharded_kernel_matches_reg(rng, _interpret_mode, b, n_data, n_corr):
     """reg_fused + corr_w2_shards engages the Pallas kernel per shard
     (full-manual shard_map); values must match unsharded reg exactly, in
     both the replicated-batch and split-batch spec branches."""
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=n_corr, corr_backend="reg_fused")
     mesh = make_mesh(n_data=n_data, n_corr=n_corr)
     h, w1, w2 = 4, 24, 40
@@ -164,6 +174,8 @@ def test_sharded_kernel_matches_reg(rng, _interpret_mode, b, n_data, n_corr):
 def test_sharded_kernel_gradients_match_reg(rng, _interpret_mode):
     """Feature gradients THROUGH the per-shard Pallas kernel (custom VJP
     inside a full-manual shard_map) match the unsharded reg backend."""
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=2, corr_backend="reg_fused")
     mesh = make_mesh(n_data=4, n_corr=2)
     b, h, w1, w2 = 1, 4, 24, 40
@@ -194,6 +206,8 @@ def test_sharded_fullres_structure(rng, _interpret_mode):
     through the sharded volume + Pallas kernel on the virtual mesh — H kept
     tiny so the CPU interpreter stays fast; the W2 math (padding quantum,
     level widths 496/248/124/62, shard offsets) is the full-res case."""
+    from conftest import require_corr_mesh
+    require_corr_mesh()
     cfg = RaftStereoConfig(corr_w2_shards=4, corr_backend="reg_fused")
     mesh = make_mesh(n_data=2, n_corr=4)
     b, h, w1, w2 = 1, 2, 496, 496
