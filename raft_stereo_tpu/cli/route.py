@@ -42,8 +42,16 @@ drains — never kills)::
          --port {port} --executable_cache_dir /shared/store --sessions" \\
         --autoscale_max 6
 
-See docs/architecture.md §Fleet and the README runbooks "a replica
-died", "roll a replica without dropping streams", "the router died".
+Canary rollout (round 21): after registering a new model version on the
+replicas (``POST /admin/models``), split a deterministic fraction of
+stateless traffic onto it — sessions never split — with shadow
+mirroring and auto-demotion on sustained regression::
+
+    raft-route ... --canary kitti@v2=0.05 --canary_shadow 0.1
+
+See docs/architecture.md §Fleet / §Multi-model and the README runbooks
+"a replica died", "roll a replica without dropping streams", "the
+router died", "roll out a new checkpoint".
 """
 
 from __future__ import annotations
@@ -56,6 +64,26 @@ import threading
 from raft_stereo_tpu.cli import common
 
 log = logging.getLogger(__name__)
+
+
+def parse_canary(spec):
+    """``model@version=FRACTION`` -> ("model@version", fraction)."""
+    if spec is None:
+        return None
+    coord, _, frac = spec.rpartition("=")
+    if not coord or not frac:
+        raise argparse.ArgumentTypeError(
+            f"{spec!r}: expected model@version=FRACTION, e.g. "
+            f"kitti@v2=0.05")
+    try:
+        fraction = float(frac)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"{spec!r}: fraction {frac!r} is not a number") from e
+    if not 0.0 <= fraction <= 1.0:
+        raise argparse.ArgumentTypeError(
+            f"{spec!r}: fraction {fraction} not in [0, 1]")
+    return coord, fraction
 
 
 def build_router(args):
@@ -83,7 +111,12 @@ def build_router(args):
         standby=args.standby,
         lease_ttl_s=args.lease_ttl_s,
         peer_url=args.peer)
-    return FleetRouter(replicas, cfg)
+    router = FleetRouter(replicas, cfg)
+    canary = parse_canary(args.canary)
+    if canary is not None:
+        router.rollout.set_canary(canary[0], canary[1],
+                                  shadow_fraction=args.canary_shadow)
+    return router
 
 
 def build_autoscaler(args, router):
@@ -209,6 +242,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lease staleness window: the standby takes "
                         "over once the primary has not renewed for "
                         "this long")
+    # Canary/shadow rollout (fleet/rollout.py).
+    p.add_argument("--canary", default=None,
+                   help="arm a canary split at boot: model@version="
+                        "FRACTION, e.g. kitti@v2=0.05 routes 5%% of "
+                        "stateless default-model traffic to the kitti "
+                        "v2 registered model (deterministic body hash; "
+                        "sessions never split).  Also drivable live via "
+                        "POST /admin/rollout")
+    p.add_argument("--canary_shadow", type=float, default=0.0,
+                   help="additionally mirror this fraction of BASELINE "
+                        "requests to the canary fire-and-forget; the "
+                        "answers are EPE-compared and dropped — the "
+                        "regression signal for auto-demotion")
     # Autoscaling (fleet/autoscaler.py).
     p.add_argument("--autoscale_cmd", default=None,
                    help="enable pressure-driven autoscaling: a "

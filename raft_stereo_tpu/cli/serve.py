@@ -103,6 +103,10 @@ def build_service(args):
         tile_rows=args.tile_rows,
         tile_halo=args.tile_halo,
         warmup_shapes=tuple(args.warmup_shape or ()),
+        models=tuple(m.strip() for m in (args.models or "").split(",")
+                     if m.strip()),
+        model_store_dir=args.model_store_dir,
+        default_model=args.default_model,
         prewarm_on_init=False)
     return StereoService(cfg, variables, serve_cfg)
 
@@ -405,6 +409,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "shared artifact store: fetch warm executables "
                         "but never write (replicas against a fleet "
                         "store populated by tools/compile_farm.py)")
+    # Multi-model registry (round 21; serving/models.py).
+    p.add_argument("--models", default=None,
+                   help="comma-separated registered model specs to load "
+                        "at boot from the model store, each "
+                        "name[@version] (bare name = latest published "
+                        "version); requests pick one via ?model= / "
+                        "X-Model, and POST /admin/models hot-swaps "
+                        "more at runtime.  Unset: exactly today's "
+                        "single-model server, byte-identical")
+    p.add_argument("--model_store_dir", default=None,
+                   help="model store root (the models/<name>/<version> "
+                        "namespace; tools/publish_model.py populates "
+                        "it).  Defaults to --executable_cache_dir — "
+                        "weights and executables share one artifact "
+                        "store")
+    p.add_argument("--default_model", default=None,
+                   help="registered model name that serves requests "
+                        "naming NO model (must be in --models); unset: "
+                        "the checkpoint from --restore_ckpt stays the "
+                        "default")
     p.add_argument("--max_dispatch_attempts", type=int, default=2,
                    help="dispatch attempts per request before the typed "
                         "RequestPoisoned failure (crashed dispatches "

@@ -31,6 +31,7 @@ Round 20 (divergence-proof training) adds two production contracts:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -58,6 +59,34 @@ class LoaderBroken(RuntimeError):
     """Typed terminal loader failure: the worker pool kept dying after
     ``MAX_POOL_RESPAWNS`` consecutive respawns — respawning is not going
     to converge, a human needs to look at the dataset/host."""
+
+
+def sample_content_key(dataset, index: int) -> Optional[str]:
+    """Stable identity of a sample: SHA-256 over its file paths + sizes.
+
+    Quarantine entries persist under THIS key, not the raw index — a
+    re-listed dataset (files added/removed, indices shifted) keeps its
+    quarantine aimed at the same bad files, and a REPLACED file (a
+    re-downloaded fixed shard: different size) stops matching and leaves
+    quarantine automatically.  None when the dataset exposes no
+    ``sample_paths`` (synthetic/test datasets) — those entries fall back
+    to index identity.
+    """
+    paths_fn = getattr(dataset, "sample_paths", None)
+    if paths_fn is None:
+        return None
+    try:
+        paths = paths_fn(int(index))
+    except Exception:
+        return None
+    h = hashlib.sha256()
+    for p in paths:
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            size = -1      # missing file is still a stable identity
+        h.update(f"{p}\x00{size}\x00".encode())
+    return h.hexdigest()
 
 
 def _collate(dataset: StereoDataset, epoch: int, indices
@@ -224,16 +253,20 @@ class StereoLoader:
         # train loop mirrors into train_loader_* instruments.
         self._fault_lock = threading.Lock()
         self.quarantined: set = set()
+        # index -> content key (sample_content_key; None for datasets
+        # without file identity).  The persisted file stores the KEYS —
+        # the index is just a verification hint for the fast reload path.
+        self._quarantine_keys: Dict[int, Optional[str]] = {}
         self.stats: Dict[str, int] = {"retried": 0, "quarantined": 0,
                                       "worker_respawns": 0}
         if quarantine_path and os.path.exists(quarantine_path):
             try:
                 with open(quarantine_path) as f:
-                    self.quarantined = set(
-                        int(i) for i in json.load(f).get("indices", []))
-                log.info("loaded %d quarantined sample indices from %s",
+                    payload = json.load(f)
+                self._load_quarantine(payload)
+                log.info("loaded %d quarantined samples from %s",
                          len(self.quarantined), quarantine_path)
-            except (OSError, ValueError, TypeError):
+            except (OSError, ValueError, TypeError, KeyError):
                 log.warning("unreadable quarantine file %s; starting empty",
                             quarantine_path)
         # Exact-resume position: the NEXT batch yielded by a fresh
@@ -242,6 +275,80 @@ class StereoLoader:
         # are the rewind reshuffle events (epoch, batch, salt).
         self.start_offset = 0
         self.salts: Tuple[Tuple[int, int, int], ...] = ()
+
+    # --------------------------------------------------- quarantine persist
+    def _load_quarantine(self, payload: Dict) -> None:
+        """Rebuild the quarantine set from a persisted payload.
+
+        v2 format (``{"version": 2, "samples": [{"key", "index"}, ...]}``)
+        stores content keys with the index as a verification hint: a key
+        that still matches its recorded index adopts it directly; a
+        mismatch (re-listed dataset) triggers ONE full relocation scan; a
+        key found nowhere is dropped — the bad file was replaced or
+        removed, so the sample re-earns its quarantine or rejoins
+        rotation.  The legacy v1 format (``{"indices": [...]}``) is
+        migrated in place: indices adopt as-is, their keys are computed
+        now, and the next persist rewrites the file as v2.
+        """
+        n = len(self.dataset)
+        if payload.get("version") == 2:
+            relocate: List[str] = []
+            for ent in payload.get("samples", ()):
+                key, idx = ent.get("key"), ent.get("index")
+                if key is None:
+                    # No file identity when persisted — index is all we have.
+                    if isinstance(idx, int) and 0 <= idx < n:
+                        self.quarantined.add(idx)
+                        self._quarantine_keys[idx] = None
+                    continue
+                if (isinstance(idx, int) and 0 <= idx < n
+                        and sample_content_key(self.dataset, idx) == key):
+                    self.quarantined.add(idx)
+                    self._quarantine_keys[idx] = key
+                else:
+                    relocate.append(key)
+            if relocate:
+                wanted = set(relocate)
+                for i in range(n):
+                    k = sample_content_key(self.dataset, i)
+                    if k in wanted:
+                        self.quarantined.add(i)
+                        self._quarantine_keys[i] = k
+                        wanted.discard(k)
+                        if not wanted:
+                            break
+                log.warning(
+                    "quarantine relocation: %d/%d shifted samples "
+                    "re-matched by content key, %d dropped (file "
+                    "replaced/removed)", len(relocate) - len(wanted),
+                    len(relocate), len(wanted))
+        else:   # legacy v1: raw indices — adopt, compute keys, migrate
+            for i in payload.get("indices", ()):
+                i = int(i)
+                if 0 <= i < n:
+                    self.quarantined.add(i)
+                    self._quarantine_keys[i] = sample_content_key(
+                        self.dataset, i)
+            if self.quarantined:
+                log.info("migrating legacy index-keyed quarantine file "
+                         "(%d entries) to content-hash keys",
+                         len(self.quarantined))
+                self._write_quarantine(
+                    [{"index": i, "key": self._quarantine_keys.get(i)}
+                     for i in sorted(self.quarantined)])
+
+    def _write_quarantine(self, entries: List[Dict]) -> None:
+        if not self.quarantine_path:
+            return
+        try:
+            tmp = f"{self.quarantine_path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"version": 2, "samples": entries}, f)
+                f.write("\n")
+            os.replace(tmp, self.quarantine_path)
+        except OSError:  # pragma: no cover - unwritable quarantine dir
+            log.warning("could not persist quarantine list to %s",
+                        self.quarantine_path)
 
     def __len__(self) -> int:
         return len(self.dataset) // self.batch_size  # drop_last
@@ -311,21 +418,16 @@ class StereoLoader:
                 elif ev["kind"] == "quarantined":
                     if ev["index"] not in self.quarantined:
                         self.quarantined.add(ev["index"])
+                        self._quarantine_keys[ev["index"]] = (
+                            sample_content_key(self.dataset, ev["index"]))
                         self.stats["quarantined"] += 1
                         dirty = True
                     log.warning("sample %s quarantined after retry: %s",
                                 ev["index"], ev["error"])
-            snapshot = sorted(self.quarantined)
-        if dirty and self.quarantine_path:
-            try:
-                tmp = f"{self.quarantine_path}.tmp-{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump({"indices": snapshot}, f)
-                    f.write("\n")
-                os.replace(tmp, self.quarantine_path)
-            except OSError:  # pragma: no cover - unwritable quarantine dir
-                log.warning("could not persist quarantine list to %s",
-                            self.quarantine_path)
+            snapshot = [{"index": i, "key": self._quarantine_keys.get(i)}
+                        for i in sorted(self.quarantined)]
+        if dirty:
+            self._write_quarantine(snapshot)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if self.num_workers <= 0:

@@ -103,6 +103,13 @@ class Request:
     # dispatch cycle cannot reorder a session's frames.
     family: Optional[str] = None
     session_id: Optional[str] = None
+    # Model coordinate (serving/models.py registry): the registered
+    # ``name`` this request's dispatch must consume the weights of.
+    # None = the engine's implicit constructor model — the pre-registry
+    # build, byte-identical.  Part of the compatibility key: two models
+    # share shapes but never a dispatch batch (a batch is ONE forward
+    # against ONE variables tree).
+    model: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -110,8 +117,8 @@ class Request:
     @property
     def group_key(self) -> Tuple:
         """What batches together: same padded bucket, same tier, same
-        executable family (base / session-state / warm)."""
-        return (self.bucket, self.tier, self.family)
+        executable family (base / session-state / warm), same model."""
+        return (self.bucket, self.tier, self.family, self.model)
 
 
 def edf_key(req: Request) -> float:

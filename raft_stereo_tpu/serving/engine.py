@@ -117,6 +117,9 @@ from raft_stereo_tpu.serving.batcher import (BucketQueue, Overloaded,
                                              decompose_batch)
 from raft_stereo_tpu.serving.chaos import ChaosConfig, ChaosInjector
 from raft_stereo_tpu.serving.metrics import MetricsRegistry, ServingMetrics
+from raft_stereo_tpu.serving.models import (ModelStore, ModelUnknown,
+                                            RegisteredModel, model_coord,
+                                            parse_model_spec)
 from raft_stereo_tpu.serving.resilience import (CIRCUIT_CLOSED,
                                                 BrownoutController,
                                                 CircuitBreaker,
@@ -423,6 +426,23 @@ class ServeConfig:
     # residual as seam error).
     tile_rows: int = 512
     tile_halo: int = 64
+    # ---- Model registry (round 21; serving/models.py) ------------------
+    # Registered model versions to load at boot from the artifact
+    # store's models/ namespace: "name@version" specs (bare "name" =
+    # the newest complete version).  Requests pick one with ?model= /
+    # X-Model; each registered model carries its OWN RaftStereoConfig
+    # and compiles its own executable ladder (distinct compile-cost,
+    # persist, and dispatch-group keys — models never share a batch).
+    # Empty (default): exactly today's single implicit constructor
+    # model — every key, program, and wire byte unchanged.
+    models: Tuple[str, ...] = ()
+    # Root of the model store; defaults to executable_cache_dir (the
+    # weights live NEXT to the executables they compile into).  Required
+    # when ``models`` is non-empty or hot registration is wanted.
+    model_store_dir: Optional[str] = None
+    # The registered model unnamed requests run (the default pointer a
+    # hot swap flips); None = the implicit constructor model.
+    default_model: Optional[str] = None
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -551,6 +571,21 @@ class ServeConfig:
                 f"dispatch)")
         if self.tile_halo < 0:
             raise ValueError(f"tile_halo={self.tile_halo} must be >= 0")
+        model_names = [parse_model_spec(s)[0] for s in self.models]
+        if len(set(model_names)) != len(model_names):
+            raise ValueError(f"models={self.models}: duplicate model "
+                             f"names (one served version per name)")
+        if self.models and not (self.model_store_dir
+                                or self.executable_cache_dir):
+            raise ValueError(
+                "ServeConfig.models needs a store to load from: set "
+                "model_store_dir (or executable_cache_dir — the shared "
+                "artifact store holds the models/ namespace)")
+        if (self.default_model is not None
+                and self.default_model not in model_names):
+            raise ValueError(
+                f"default_model={self.default_model!r} is not one of the "
+                f"registered model names {model_names}")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -611,6 +646,12 @@ class ServeResult:
     mesh: Optional[str] = None
     tiles: Optional[int] = None
     seam_epe: Optional[float] = None
+    # Model provenance (round 21, serving/models.py): which registered
+    # model answered — None/None for the implicit constructor model
+    # (wire bytes unchanged); the HTTP layer renders these as
+    # X-Model / X-Model-Version.
+    model: Optional[str] = None
+    model_version: Optional[str] = None
 
     @property
     def degraded(self) -> bool:
@@ -658,6 +699,40 @@ class _XlGroup:
     def label(self) -> str:
         return "+".join(str(getattr(d, "id", i))
                         for i, d in enumerate(self.devices))
+
+
+@dataclasses.dataclass
+class _EngineModel:
+    """One served model's engine-side state: the identity coordinate
+    plus everything the dispatch path reads per model — the effective
+    config, the per-tier model objects, the per-worker resident fp32
+    trees, and the lazily quantized int8 trees.  The implicit
+    constructor model is the ``name=None`` bundle; its fields are
+    exactly the attributes the pre-registry engine kept flat on
+    ``self`` (which stay as aliases — same objects, zero behavior
+    drift)."""
+
+    name: Optional[str]          # None = the implicit constructor model
+    version: Optional[str]
+    config: RaftStereoConfig
+    effective_config: RaftStereoConfig
+    model: RAFTStereo
+    tier_models: Dict[Optional[str], RAFTStereo]
+    host_variables: object
+    worker_vars: List
+    qvars_host: object = None
+    qvars: Dict[int, object] = dataclasses.field(default_factory=dict)
+    # Retirement latch: resolve_model refuses a retiring model (typed
+    # 404) while its in-flight dispatches drain.
+    retiring: bool = False
+
+    @property
+    def coord(self) -> Optional[str]:
+        """``name@version``, or None for the implicit model — the tag
+        compile-cost keys, persist keys, and metric labels carry."""
+        if self.name is None:
+            return None
+        return model_coord(self.name, self.version or "0")
 
 
 @dataclasses.dataclass
@@ -857,16 +932,6 @@ class ServingEngine:
             self._quant_corr_scales = corr_scales(
                 load_scales(serve_cfg.quant_scales_path))
 
-        def effective(cfg_in: RaftStereoConfig) -> RaftStereoConfig:
-            eff = effective_inference_config(cfg_in, serve_cfg.iters)
-            if (eff.quant != "off" and self._quant_corr_scales is not None
-                    and eff.quant_corr_scales is None):
-                eff = dataclasses.replace(
-                    eff, quant_corr_scales=self._quant_corr_scales)
-            return eff
-
-        self.effective_config = effective(config)
-        self.model = RAFTStereo(self.effective_config)
         # Latency tiers: one effective config / model per tier (the
         # early-exit + quant knobs swapped into the SAME architecture —
         # the parameter tree is shared, only the compiled program
@@ -882,31 +947,47 @@ class ServingEngine:
             self.default_tier = serve_cfg.default_tier or (
                 "quality" if "quality" in self.tiers
                 else next(iter(self.tiers)))
-        self._tier_models: Dict[Optional[str], RAFTStereo] = {
-            None: self.model}
-        for name, tier in self.tiers.items():
-            eff = effective(tier.apply(config))
-            self._tier_models[name] = (
-                self.model if eff == self.effective_config
-                else RAFTStereo(eff))
         if serve_cfg.session_ctx_cache and config.shared_backbone:
             raise ValueError(
                 "session_ctx_cache is unsupported with shared_backbone: "
                 "fnet is computed from the cnet trunk, so the context "
                 "encoder cannot be skipped (models/raft_stereo.py)")
-        # Per-worker resident variables + the engine-owned executable
-        # cache: (worker, padded shape, batch size) -> compiled forward,
-        # bounded per worker, oldest evicted.
-        self._worker_vars = [jax.device_put(variables, d)
-                             for d in self.devices]
-        # Int8 tiers' per-worker quantized trees, built lazily: the host
-        # quantization (quant/core.quantize_variables) runs at most once
-        # per engine and each worker keeps its own device copy — the
-        # fp32 ``_worker_vars`` stay untouched for full-precision tiers.
-        self._host_variables = variables
+        # Model registry (round 21, serving/models.py): every served
+        # model — the implicit constructor one under key None, plus any
+        # registered "name@version" — keeps its per-model state in one
+        # _EngineModel bundle.  The int8 quantization lock is shared
+        # (host quantization runs at most once per bundle).
         self._qvars_lock = threading.Lock()
-        self._qvars_host = None
-        self._qvars: Dict[int, object] = {}
+        self._models_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._model_pending: Dict[Optional[str], int] = {}
+        base_bundle = self._build_bundle(None, None, config, variables)
+        self._models: Dict[Optional[str], _EngineModel] = {
+            None: base_bundle}
+        # Flat aliases of the implicit bundle — the pre-registry
+        # attribute surface every existing call site (HTTP, CLIs,
+        # tests) keeps reading.  Same objects, zero drift.
+        self.config = base_bundle.config
+        self.effective_config = base_bundle.effective_config
+        self.model = base_bundle.model
+        self._tier_models = base_bundle.tier_models
+        self._worker_vars = base_bundle.worker_vars
+        self._host_variables = variables
+        # The model store + boot-time registrations (ServeConfig.models).
+        self.model_store: Optional[ModelStore] = None
+        store_dir = (serve_cfg.model_store_dir
+                     or serve_cfg.executable_cache_dir)
+        if store_dir and (serve_cfg.models
+                          or serve_cfg.model_store_dir):
+            self.model_store = ModelStore(store_dir)
+        self.default_model: Optional[str] = None
+        for spec in serve_cfg.models:
+            reg = self.model_store.resolve(spec)   # deep-verified load
+            self._models[reg.name] = self._build_bundle(
+                reg.name, reg.version, reg.config, reg.variables)
+            log.info("model %s registered at boot", reg.coord)
+        if serve_cfg.default_model is not None:
+            self.default_model = serve_cfg.default_model
         self._cache_lock = threading.Lock()
         self._compiled: "collections.OrderedDict[Tuple, object]" = (
             collections.OrderedDict())
@@ -1037,17 +1118,21 @@ class ServingEngine:
                 # This bucket's traffic runs on the xl mesh groups —
                 # warming the solo ladder for it would pay megapixel
                 # single-device compiles no request will ever dispatch.
+                # (Named models never route xl, so the entry is
+                # implicit-model only.)
                 for widx in self._xl_worker_indices():
                     for n in self._xl_sizes:
                         self._warm_target.add(
-                            (widx, (hp, wp), n, None, FAMILY_XL))
+                            (widx, (hp, wp), n, None, FAMILY_XL, None))
                 continue
-            for widx in range(len(self.devices)):
-                for tier in self._distinct_cache_tiers():
-                    for n in self.queue.sizes:
-                        for family in self._families():
-                            self._warm_target.add(
-                                (widx, (hp, wp), n, tier, family))
+            for mname in self._registered_names():
+                for widx in range(len(self.devices)):
+                    for tier in self._distinct_cache_tiers(mname):
+                        for n in self.queue.sizes:
+                            for family in self._families():
+                                self._warm_target.add(
+                                    (widx, (hp, wp), n, tier, family,
+                                     mname))
         self._closed = False
         self._shutting_down = False
         self._workers_lock = threading.Lock()
@@ -1083,6 +1168,235 @@ class ServingEngine:
         transitions emit anomaly run events + flight-recorder bundles
         through the same path the watchdogs use."""
         self.sink = sink
+
+    # -------------------------------------------------------- model registry
+    def _effective(self, cfg_in: RaftStereoConfig) -> RaftStereoConfig:
+        """One model config's effective inference form: the solo runner's
+        deep-iteration guard plus the calibrated int8 correlation scales
+        swapped into quantized configs (quant/calibrate.py)."""
+        eff = effective_inference_config(cfg_in, self.serve_cfg.iters)
+        if (eff.quant != "off" and self._quant_corr_scales is not None
+                and eff.quant_corr_scales is None):
+            eff = dataclasses.replace(
+                eff, quant_corr_scales=self._quant_corr_scales)
+        return eff
+
+    def _build_bundle(self, name: Optional[str], version: Optional[str],
+                      config: RaftStereoConfig, variables) -> _EngineModel:
+        """Build one model's engine-side state: effective config, the
+        per-tier model objects (fixed-depth tiers share the bundle's
+        base model — one program per DISTINCT effective config), and the
+        per-worker resident fp32 trees.  Same construction for the
+        implicit model and every registered one."""
+        import jax
+
+        eff = self._effective(config)
+        model = RAFTStereo(eff)
+        tier_models: Dict[Optional[str], RAFTStereo] = {None: model}
+        for tname, tier in self.tiers.items():
+            teff = self._effective(tier.apply(config))
+            tier_models[tname] = (model if teff == eff
+                                  else RAFTStereo(teff))
+        worker_vars = [jax.device_put(variables, d)
+                       for d in self.devices]
+        return _EngineModel(name=name, version=version, config=config,
+                            effective_config=eff, model=model,
+                            tier_models=tier_models,
+                            host_variables=variables,
+                            worker_vars=worker_vars)
+
+    def _registered_names(self, include_implicit: bool = True
+                          ) -> List[Optional[str]]:
+        """Model names this engine serves, implicit first — what the
+        warm target and prewarm iterate."""
+        with self._models_lock:
+            names = sorted(n for n in self._models if n is not None)
+        return ([None] + names) if include_implicit else names
+
+    def resolve_model(self, model: Optional[str]) -> Optional[str]:
+        """The model a request actually runs: the named one (validated
+        against the registry), or the default-model pointer, or None
+        (the implicit constructor model).  Raises the typed
+        ``ModelUnknown`` (HTTP 404 ``model_unknown``) on an
+        unregistered or retiring name."""
+        if model is None:
+            model = self.default_model
+        if model is None:
+            return None
+        bundle = self._models.get(model)
+        if bundle is None or bundle.retiring:
+            with self._models_lock:
+                known = [n for n, b in self._models.items()
+                         if n is not None and not b.retiring]
+            raise ModelUnknown(model, known)
+        return model
+
+    def _note_pending(self, model: Optional[str], delta: int) -> None:
+        """Per-model in-flight admission count — ``retire_model``'s
+        drain signal (a model with pending admissions must not have its
+        pytree evicted under a dispatch that will still read it)."""
+        with self._pending_lock:
+            self._model_pending[model] = (
+                self._model_pending.get(model, 0) + delta)
+
+    def _model_pending_count(self, model: Optional[str]) -> int:
+        with self._pending_lock:
+            return self._model_pending.get(model, 0)
+
+    def _extend_warm_target(self, name: str) -> None:
+        """Grow the /readyz warm surface by one registered model's
+        ladder: ``ready`` flips False until the new model's prewarm
+        completes — a hot swap can never report ready ahead of a warm
+        ladder (acceptance: model_smoke asserts this)."""
+        with self._warm_lock:
+            for hw in self.serve_cfg.warmup_shapes:
+                hp, wp, _ = self.policy.bucket_for(int(hw[0]),
+                                                   int(hw[1]))
+                if self._xl_routes((hp, wp)):
+                    continue    # named models never route xl
+                for widx in range(len(self.devices)):
+                    for tier in self._distinct_cache_tiers(name):
+                        for n in self.queue.sizes:
+                            for family in self._families():
+                                self._warm_target.add(
+                                    (widx, (hp, wp), n, tier, family,
+                                     name))
+
+    def _purge_model_cache(self, name: str,
+                           drop_target: bool = False) -> None:
+        """Drop one model's in-memory compiled executables and warm
+        entries (same-name version replace / retirement).  Disk-cache
+        entries stay — their content keys carry the version, so they
+        can never serve the wrong weights."""
+        with self._cache_lock:
+            for k in [k for k in self._compiled if k[5] == name]:
+                self._compiled.pop(k)
+        with self._warm_lock:
+            self._warmed = {e for e in self._warmed if e[5] != name}
+            if drop_target:
+                self._warm_target = {e for e in self._warm_target
+                                     if e[5] != name}
+
+    def register_model(self, spec: str, set_default: bool = False,
+                       prewarm: bool = True) -> Dict[str, object]:
+        """Hot-register a model version on this LIVE engine (``POST
+        /admin/models``): deep-verified store load, bundle build
+        (device placement; the turbo tier quantizes lazily at first
+        dispatch), warm-target extension, prewarm of the declared
+        ladder through the warm artifact-store path, and — only then,
+        when asked — the atomic default-pointer flip.  Re-registering
+        the SAME name@version is idempotent; a new version under a
+        live name replaces it (its in-memory executables purge; the
+        old pytree is released once in-flight dispatches drain)."""
+        if self.model_store is None:
+            store_dir = (self.serve_cfg.model_store_dir
+                         or self.serve_cfg.executable_cache_dir)
+            if not store_dir:
+                raise RuntimeError(
+                    "no model store: construct the engine with "
+                    "ServeConfig.model_store_dir (or "
+                    "executable_cache_dir) to register models")
+            self.model_store = ModelStore(store_dir)
+        reg = self.model_store.resolve(spec)   # deep SHA-256 verify
+        with self._models_lock:
+            existing = self._models.get(reg.name)
+            fresh = not (existing is not None
+                         and existing.version == reg.version
+                         and not existing.retiring)
+        if fresh:
+            bundle = self._build_bundle(reg.name, reg.version,
+                                        reg.config, reg.variables)
+            if existing is not None:
+                # Same-name version replace: the old version's
+                # executables must never answer the new version's
+                # requests (the in-memory cache keys by NAME).
+                self._purge_model_cache(reg.name)
+            with self._models_lock:
+                self._models[reg.name] = bundle
+            self._extend_warm_target(reg.name)
+            log.info("model %s registered%s", reg.coord,
+                     " (replacing a live version)" if existing else "")
+            if prewarm:
+                for hw in self.serve_cfg.warmup_shapes:
+                    self.prewarm(hw, models=[reg.name])
+        if set_default:
+            self.set_default_model(reg.name)
+        return {"model": reg.name, "version": reg.version,
+                "registered": bool(fresh),
+                "default": self.default_model,
+                "ready": self.ready}
+
+    def set_default_model(self, name: Optional[str]) -> Optional[str]:
+        """Atomically flip the default-model pointer (what unnamed
+        requests run); None restores the implicit constructor model.
+        The flip is the LAST step of a rollout — ``register_model``
+        prewarms before it, so the first post-flip request hits warm
+        executables."""
+        with self._models_lock:
+            if name is not None:
+                b = self._models.get(name)
+                if b is None or b.retiring:
+                    raise ModelUnknown(
+                        name, [n for n, bb in self._models.items()
+                               if n is not None and not bb.retiring])
+            previous, self.default_model = self.default_model, name
+        log.info("default model: %s -> %s", previous, name)
+        return name
+
+    def retire_model(self, name: str, timeout: float = 30.0
+                     ) -> Dict[str, object]:
+        """Retire a registered model from this live engine: latch it
+        retiring (new requests get the typed 404), DRAIN its in-flight
+        admissions, then evict the pytree and purge its executables.
+        Refuses the current default (RuntimeError — flip the pointer
+        first; HTTP 409) and raises ``TimeoutError`` (retiring latch
+        released) if in-flight work does not drain in ``timeout``."""
+        with self._models_lock:
+            bundle = self._models.get(name) if name is not None else None
+            if bundle is None:
+                raise ModelUnknown(
+                    name, [n for n in self._models if n is not None])
+            if self.default_model == name:
+                raise RuntimeError(
+                    f"model {name!r} is the default — set_default_model "
+                    f"to another version before retiring it")
+            bundle.retiring = True
+        deadline = time.monotonic() + max(0.0, timeout)
+        while self._model_pending_count(name) > 0:
+            if time.monotonic() > deadline:
+                with self._models_lock:
+                    bundle.retiring = False
+                raise TimeoutError(
+                    f"model {name!r}: {self._model_pending_count(name)} "
+                    f"admission(s) still in flight after {timeout}s — "
+                    f"retirement rolled back")
+            time.sleep(0.005)
+        with self._models_lock:
+            self._models.pop(name, None)
+        self._purge_model_cache(name, drop_target=True)
+        with self._pending_lock:
+            self._model_pending.pop(name, None)
+        log.info("model %s retired (drained, pytree evicted)",
+                 bundle.coord)
+        return {"model": name, "version": bundle.version,
+                "retired": True}
+
+    def models_status(self) -> Dict[str, object]:
+        """The registry's JSON line (/healthz, /admin/models GET):
+        registered versions, the default pointer, per-model in-flight
+        admissions."""
+        with self._models_lock:
+            registered = [
+                {"name": b.name, "version": b.version,
+                 "coord": b.coord, "retiring": b.retiring}
+                for n, b in sorted(self._models.items(),
+                                   key=lambda kv: kv[0] or "")
+                if n is not None]
+        with self._pending_lock:
+            pending = {(k if k is not None else "(implicit)"): v
+                       for k, v in self._model_pending.items() if v > 0}
+        return {"default": self.default_model,
+                "registered": registered, "pending": pending}
 
     # -------------------------------------------------------------- xl tier
     def _xl_model_config(self, spec: Dict[str, int]) -> RaftStereoConfig:
@@ -1303,7 +1617,8 @@ class ServingEngine:
     def submit(self, left: np.ndarray, right: np.ndarray,
                deadline_ms: Optional[float] = None,
                tier: Optional[str] = None,
-               degradable: bool = True) -> Future:
+               degradable: bool = True,
+               model: Optional[str] = None) -> Future:
         """Admit one stereo pair; returns a Future of ``ServeResult``.
 
         ``tier`` selects a configured latency tier (``ServeConfig.tiers``)
@@ -1332,8 +1647,18 @@ class ServingEngine:
         ordinary batcher and the stitched result carries ``tiles`` /
         ``seam_epe``.  Naming ``tier="xl"`` without an xl tier, or for
         a mesh-incompatible bucket, raises ``ValueError`` (HTTP 400).
+
+        ``model`` (round 21) selects a REGISTERED model version
+        (``?model=`` / X-Model); None runs the default-model pointer
+        (the implicit constructor model unless a hot swap flipped it).
+        Unknown/retiring names raise the typed ``ModelUnknown``
+        (HTTP 404).  Requests of different models never share a
+        dispatch (the queue groups by model) and named models never
+        route to the xl mesh (its replicated weights are the implicit
+        model's).
         """
         t_admit = time.perf_counter()
+        model = self.resolve_model(model)
         left, right = np.asarray(left), np.asarray(right)
         if left.ndim != 3 or left.shape != right.shape:
             raise ValueError(
@@ -1346,7 +1671,13 @@ class ServingEngine:
                 "tier 'xl' requested but this engine has no xl tier "
                 "(configure ServeConfig.xl_mesh / --xl_mesh, and enough "
                 "devices for the mesh)")
-        if self.xl is not None and (want_xl or self._xl_routes(bucket)):
+        if want_xl and model is not None:
+            raise ValueError(
+                f"tier 'xl' serves only the implicit constructor model "
+                f"(the mesh groups replicate its weights); model "
+                f"{model!r} cannot ride it")
+        if (model is None and self.xl is not None
+                and (want_xl or self._xl_routes(bucket))):
             ok, reason = self._xl_compatible(bucket)
             if ok:
                 # Fixed-depth full-precision program: no tier ladder, no
@@ -1367,9 +1698,10 @@ class ServingEngine:
         tt = self.serve_cfg.tile_threshold_pixels
         if tt is not None and bucket[0] * bucket[1] > tt:
             return self._submit_tiled(left, right, deadline_ms, tier,
-                                      requested_tier, t_admit)
+                                      requested_tier, t_admit, model)
         return self._enqueue(left, right, deadline_ms, tier,
-                             requested_tier, t_admit).future
+                             requested_tier, t_admit,
+                             model=model).future
 
     def _admit_tier(self, tier: Optional[str], degradable: bool
                     ) -> Tuple[Optional[str], Optional[str]]:
@@ -1394,10 +1726,13 @@ class ServingEngine:
                  frame_index: Optional[int] = None,
                  scene_cut: bool = False,
                  frame_delta_v: Optional[float] = None,
-                 ctx_init=None, hidden_init=None) -> Request:
+                 ctx_init=None, hidden_init=None,
+                 model: Optional[str] = None) -> Request:
         """Pad, build, trace, and queue one request — shared by the
         stateless ``submit`` (base family, no session fields) and the
-        streaming ``submit_session``."""
+        streaming ``submit_session``.  ``model`` is the RESOLVED
+        registered-model name (None = implicit) — it joins the queue
+        group key, so models never share a dispatch."""
         hp, wp, grid = self.policy.bucket_for(left.shape[0], left.shape[1])
         padder = InputPadder((1,) + left.shape, divis_by=grid)
         l, r, t, b = padder.pads
@@ -1417,8 +1752,18 @@ class ServingEngine:
                       future=Future(), t_enqueue=now, tier=tier,
                       requested_tier=requested_tier,
                       family=family, session_id=session_id,
+                      model=model,
                       deadline=(None if deadline_ms is None
                                 else now + deadline_ms / 1e3))
+        # Per-model in-flight accounting (retire_model's drain signal):
+        # incremented before the queue sees the request, decremented by
+        # the future resolving — admission-to-resolution coverage, so a
+        # retiring model's pytree is never evicted under a live
+        # dispatch.  The Overloaded path below decrements explicitly
+        # (a refused request's future never resolves).
+        self._note_pending(model, +1)
+        req.future.add_done_callback(
+            lambda f, m=model: self._note_pending(m, -1))
         # Sampled request: root span + admission (validate/pad) span; the
         # queue span opens here and closes at worker pickup (_run_chunk)
         # or in the done-callback for requests dropped in the queue.
@@ -1438,6 +1783,7 @@ class ServingEngine:
         try:
             self.queue.submit(req)     # raises Overloaded at the door
         except Overloaded:
+            self._note_pending(model, -1)   # refused: future never resolves
             if trace is not None and trace.root is not None:
                 trace.root.set_attr("status", "overloaded")
                 self._finish_request_trace(req, None)
@@ -1467,16 +1813,19 @@ class ServingEngine:
               deadline_ms: Optional[float] = None,
               timeout: Optional[float] = None,
               tier: Optional[str] = None,
-              degradable: bool = True) -> ServeResult:
+              degradable: bool = True,
+              model: Optional[str] = None) -> ServeResult:
         """Blocking convenience: submit + wait (the in-process client)."""
         return self.submit(left, right, deadline_ms, tier=tier,
-                           degradable=degradable).result(timeout=timeout)
+                           degradable=degradable,
+                           model=model).result(timeout=timeout)
 
     # ------------------------------------------------------ tiled dispatch
     def _submit_tiled(self, left: np.ndarray, right: np.ndarray,
                       deadline_ms: Optional[float], tier: Optional[str],
                       requested_tier: Optional[str],
-                      t_admit: float) -> Future:
+                      t_admit: float,
+                      model: Optional[str] = None) -> Future:
         """Answer one beyond-threshold pair as N halo-overlap row tiles
         through the ORDINARY bucket path (serving/tiles.py): every tile
         is an equal-height `_enqueue` at the same bucket/tier/family, so
@@ -1497,11 +1846,13 @@ class ServingEngine:
         if len(specs) < 2:
             # Shorter than one tile extent: nothing to split.
             return self._enqueue(left, right, deadline_ms, tier,
-                                 requested_tier, t_admit).future
+                                 requested_tier, t_admit,
+                                 model=model).future
         reqs = [self._enqueue(
                     np.ascontiguousarray(left[s.src0:s.src1]),
                     np.ascontiguousarray(right[s.src0:s.src1]),
-                    deadline_ms, tier, requested_tier, t_admit)
+                    deadline_ms, tier, requested_tier, t_admit,
+                    model=model)
                 for s in specs]
         agg: Future = Future()
         state = {"remaining": len(reqs), "done": False}
@@ -1526,7 +1877,7 @@ class ServingEngine:
             elif action == "finish":
                 try:
                     self._finish_tiled(agg, reqs, specs, tier,
-                                       requested_tier, t_admit)
+                                       requested_tier, t_admit, model)
                 except BaseException as e:  # noqa: BLE001 — typed to caller
                     agg.set_exception(e)
 
@@ -1537,7 +1888,8 @@ class ServingEngine:
     def _finish_tiled(self, agg: Future, reqs: List[Request],
                       specs, tier: Optional[str],
                       requested_tier: Optional[str],
-                      t_admit: float) -> None:
+                      t_admit: float,
+                      model: Optional[str] = None) -> None:
         """All tiles answered: stitch, measure the seam, resolve the
         aggregate.  Latency legs report the worst tile (the tiles ran
         concurrently); ``total_s`` is admission -> stitched."""
@@ -1561,7 +1913,9 @@ class ServingEngine:
             iters_used=max(iters) if iters else None,
             tier=tier, requested_tier=requested_tier,
             attempts=max(res.attempts for res in results),
-            tiles=len(reqs), seam_epe=seam))
+            tiles=len(reqs), seam_epe=seam,
+            model=results[0].model,
+            model_version=results[0].model_version))
 
     # ---------------------------------------------------- streaming sessions
     def submit_session(self, session_id: str, left: np.ndarray,
@@ -1569,7 +1923,8 @@ class ServingEngine:
                        deadline_ms: Optional[float] = None,
                        tier: Optional[str] = None,
                        degradable: bool = True,
-                       handoff_key: Optional[str] = None) -> Future:
+                       handoff_key: Optional[str] = None,
+                       model: Optional[str] = None) -> Future:
         """Admit one frame of a streaming session (the engine behind
         ``POST /v1/stream/<session>``).  Returns a Future of
         ``ServeResult`` whose session fields say what happened:
@@ -1591,7 +1946,16 @@ class ServingEngine:
         still pending (distinct sessions proceed concurrently and batch
         together freely).  Every admitted frame terminates (success or
         typed error; round-13 guarantee), so the lock cannot be held
-        forever."""
+        forever.
+
+        **Model pinning (round 21):** a session PINS the model its
+        first frame resolved (the explicit ``model`` or the
+        then-current default) — later frames run that model even if a
+        hot swap flips the default mid-stream, so no session ever
+        receives frames from two different versions.  A later frame
+        naming a DIFFERENT model than the pin raises ``ValueError``
+        (HTTP 400); a frame whose pinned model was retired raises the
+        typed ``ModelUnknown`` (404 — open a fresh session)."""
         if self.sessions is None:
             raise SessionsDisabled(
                 "this engine runs without a session store — construct it "
@@ -1613,11 +1977,30 @@ class ServingEngine:
                 # this id's first frame here with the draining replica's
                 # published blob — import THAT session's state so this
                 # frame warm-starts exactly where the old replica left
-                # off.  Any failure (missing blob, corrupt entry) just
-                # leaves ``created`` true: the frame cold-starts, which
-                # is the pre-handoff baseline.
+                # off.  Any failure (missing blob, corrupt entry,
+                # unregistered pinned model) just leaves ``created``
+                # true: the frame cold-starts, which is the pre-handoff
+                # baseline.
                 created = not self._adopt_handoff(sess, session_id,
                                                   handoff_key)
+            if created:
+                # Pin the model at session birth: the explicit name or
+                # the CURRENT default — frames of this stream run it
+                # for the session's whole life, hot swaps
+                # notwithstanding.
+                sess.model = self.resolve_model(model)
+            else:
+                pinned = sess.model
+                if model is not None and model != pinned:
+                    raise ValueError(
+                        f"session {session_id!r} is pinned to model "
+                        f"{pinned or '(implicit)'} — a mid-stream "
+                        f"switch to {model!r} would mix versions; open "
+                        f"a new session")
+                if pinned is not None:
+                    # Retired mid-stream -> typed 404 on the next frame.
+                    self.resolve_model(pinned)
+            req_model = sess.model
             thumb = frame_thumbnail(left)
             hp, wp, _grid = self.policy.bucket_for(left.shape[0],
                                                    left.shape[1])
@@ -1677,7 +2060,8 @@ class ServingEngine:
                              else None),
                 ctx_init=ctx_init,
                 thumb=thumb, frame_index=sess.frame_index,
-                scene_cut=scene_cut, frame_delta_v=delta)
+                scene_cut=scene_cut, frame_delta_v=delta,
+                model=req_model)
         except BaseException:
             sess.order_lock.release()
             raise
@@ -1691,12 +2075,13 @@ class ServingEngine:
                       timeout: Optional[float] = None,
                       tier: Optional[str] = None,
                       degradable: bool = True,
-                      handoff_key: Optional[str] = None) -> ServeResult:
+                      handoff_key: Optional[str] = None,
+                      model: Optional[str] = None) -> ServeResult:
         """Blocking convenience: submit_session + wait."""
         return self.submit_session(
             session_id, left, right, deadline_ms, tier=tier,
-            degradable=degradable,
-            handoff_key=handoff_key).result(timeout=timeout)
+            degradable=degradable, handoff_key=handoff_key,
+            model=model).result(timeout=timeout)
 
     # ------------------------------------------------------ session handoff
     def exec_config_fingerprint(self) -> str:
@@ -1720,6 +2105,15 @@ class ServingEngine:
             "iters": self.serve_cfg.iters,
             "fetch_dtype": self.serve_cfg.fetch_dtype,
         }
+        if self.default_model is not None:
+            # The default-model coordinate joins the fingerprint ONLY
+            # when a registered model holds the pointer (the implicit
+            # default keeps the pre-registry fingerprint byte-stable):
+            # a handoff exported under one default version is refused
+            # typed-cold by an importer whose default moved — never a
+            # wrong-weights warm frame.
+            bundle = self._models[self.default_model]
+            payload["default_model"] = bundle.coord
         import json as json_mod
         return hashlib.sha256(
             json_mod.dumps(payload, sort_keys=True).encode()).hexdigest()
@@ -1774,12 +2168,25 @@ class ServingEngine:
     def _adopt_handoff(self, sess, sid: str, key: str) -> bool:
         """Install the handed-off state for ``sid`` from blob ``key``
         into the freshly created session; True when adopted (the frame
-        may warm-start)."""
+        may warm-start).  A session pinned to a model THIS engine does
+        not serve is refused typed (it cold-starts on whatever this
+        engine's default is — never a wrong-weights warm frame)."""
         rec = self._handoff_records(key).get(sid)
         if rec is None:
             return False
         meta, arrays = rec
+        pinned = meta.get("model") if isinstance(meta, dict) else None
+        if pinned is not None:
+            bundle = self._models.get(pinned)
+            if bundle is None or bundle.retiring:
+                self.metrics.observe_handoff_skip("model_unknown", 1)
+                log.warning(
+                    "session %s was pinned to model %r which this "
+                    "engine does not serve — refusing its handed-off "
+                    "state (cold start)", sid, pinned)
+                return False
         self.sessions.adopt(sess, meta, arrays)
+        sess.model = pinned
         self.metrics.sessions_adopted.inc()
         log.info("session %s adopted from handoff %s at frame %s "
                  "(imported warm-start state)", sid, key[:12],
@@ -1860,8 +2267,10 @@ class ServingEngine:
                 if (self.serve_cfg.session_reseed_on_cap and res.warm
                         and res.iters_used is not None
                         and res.iters_used >= self.serve_cfg.iters
-                        and early_exit_enabled(self._tier_models[
-                            self._cache_tier(req.tier)].config)):
+                        and early_exit_enabled(
+                            self._models[req.model].tier_models[
+                                self._cache_tier(req.tier, req.model)
+                            ].config)):
                     # Keyframe guard (ServeConfig.session_reseed_on_cap):
                     # the gate never fired, so this warm output is not a
                     # trusted init — drop the state and let the next
@@ -1934,14 +2343,20 @@ class ServingEngine:
         out["compiles_warm"] = self.metrics.compiles_warm.value
         if self.disk_cache is not None:
             out["executable_cache"] = self.disk_cache.stats()
+        # The registry joins the readiness detail ONLY when named
+        # models exist — a single-model engine's payload stays
+        # byte-identical to the pre-registry build.
+        if len(self._models) > 1 or self.default_model is not None:
+            out["models"] = self.models_status()
         return out
 
     def _note_warm(self, widx: int, bucket: Tuple[int, int], batch: int,
                    cache_tier: Optional[str],
-                   family: Optional[str] = FAMILY_BASE) -> None:
+                   family: Optional[str] = FAMILY_BASE,
+                   model: Optional[str] = None) -> None:
         with self._warm_lock:
             self._warmed.add((widx, tuple(bucket), batch, cache_tier,
-                              family))
+                              family, model))
 
     def _families(self) -> Tuple[Optional[str], ...]:
         """The executable families this engine serves: the base program
@@ -1969,30 +2384,35 @@ class ServingEngine:
         return (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)
 
     # ------------------------------------------------------- tier variables
-    def _vars_for(self, widx: int, cache_tier: Optional[str]):
+    def _vars_for(self, widx: int, cache_tier: Optional[str],
+                  model: Optional[str] = None):
         """The variable tree a tier's executables consume on one worker:
-        the resident fp32 tree for full-precision tiers, the per-worker
-        int8 tree for quant tiers (built lazily, host-quantized once per
-        engine — disk checkpoints stay fp32)."""
+        the bundle's resident fp32 tree for full-precision tiers, the
+        bundle's per-worker int8 tree for quant tiers (built lazily,
+        host-quantized once per bundle — disk checkpoints stay fp32).
+        Two models with identical shapes NEVER share a variables slot:
+        each bundle owns its own device placements."""
         if self._is_xl_worker(widx):
             # xl workers consume the tree replicated over their group's
             # mesh (one host->devices placement per group at boot);
-            # tiers never apply there — xl is fixed-depth fp.
+            # tiers never apply there — xl is fixed-depth fp, implicit
+            # model only.
             return self._xl_group(widx).variables
-        if self._tier_models[cache_tier].config.quant == "off":
-            return self._worker_vars[widx]
+        bundle = self._models[model]
+        if bundle.tier_models[cache_tier].config.quant == "off":
+            return bundle.worker_vars[widx]
         import jax
 
         with self._qvars_lock:
-            dev = self._qvars.get(widx)
+            dev = bundle.qvars.get(widx)
             if dev is None:
-                if self._qvars_host is None:
+                if bundle.qvars_host is None:
                     from raft_stereo_tpu.quant import quantize_variables
-                    self._qvars_host = quantize_variables(
-                        self._host_variables)
-                dev = jax.device_put(self._qvars_host,
+                    bundle.qvars_host = quantize_variables(
+                        bundle.host_variables)
+                dev = jax.device_put(bundle.qvars_host,
                                      self.devices[widx])
-                self._qvars[widx] = dev
+                bundle.qvars[widx] = dev
         return dev
 
     def _ctx_avals(self, cfg, bucket: Tuple[int, int], batch: int):
@@ -2022,31 +2442,38 @@ class ServingEngine:
         return self._ctx_avals(cfg, bucket, batch)[0]
 
     # --------------------------------------------------------- compile cache
-    def _cache_tier(self, tier: Optional[str]) -> Optional[str]:
+    def _cache_tier(self, tier: Optional[str],
+                    model: Optional[str] = None) -> Optional[str]:
         """The executable-cache key a tier compiles under: None when the
-        tier's model IS the base model (fixed-depth tiers share the base
-        executables — one program, one cost record, bitwise parity)."""
-        if tier is None or self._tier_models.get(tier) is self.model:
+        tier's model IS the bundle's base model (fixed-depth tiers share
+        the base executables — one program, one cost record, bitwise
+        parity)."""
+        bundle = self._models[model]
+        if tier is None or bundle.tier_models.get(tier) is bundle.model:
             return None
         return tier
 
-    def _distinct_cache_tiers(self) -> List[Optional[str]]:
+    def _distinct_cache_tiers(self, model: Optional[str] = None
+                              ) -> List[Optional[str]]:
         """The DISTINCT executable families the configured tiers compile
         to ("quality" and the base path normalize to one cache key) —
-        what prewarm and the readiness target iterate."""
+        what prewarm and the readiness target iterate, per model."""
         tiers = tuple(self.tiers) if self.tiers else (None,)
-        return sorted({self._cache_tier(t) for t in tiers},
+        return sorted({self._cache_tier(t, model) for t in tiers},
                       key=lambda t: (t is not None, t or ""))
 
     def _cost_key(self, bucket: Tuple[int, int], batch: int,
                   tier: Optional[str] = None,
-                  family: Optional[str] = FAMILY_BASE) -> str:
+                  family: Optional[str] = FAMILY_BASE,
+                  model: Optional[str] = None) -> str:
         """Stable label of one compile point in the cost registry — what
         GET /debug/compiles lists and the MFU path looks up.  The quant
         mode joins the key exactly like the family tag (the r14
         warm/state split): an int8 tier's executable must never share a
         cost record with the full-precision program of the same
-        (bucket, batch)."""
+        (bucket, batch).  A registered model's coordinate joins LAST
+        (",model=name@version") — the implicit model's keys stay
+        byte-identical to the pre-registry build."""
         if family == FAMILY_XL:
             # The mesh label IS the family coordinate for xl (the
             # ISSUE's ",mesh=rows4" contract): an xl executable must
@@ -2055,34 +2482,41 @@ class ServingEngine:
             label = self.xl.label if self.xl is not None else "none"
             return (f"serving.forward({bucket[0]}x{bucket[1]},b{batch}"
                     f",mesh={label})")
-        cache_tier = self._cache_tier(tier)
+        bundle = self._models[model]
+        cache_tier = self._cache_tier(tier, model)
         tail = "" if cache_tier is None else f",tier={tier}"
-        qmode = self._tier_models[cache_tier].config.quant
+        qmode = bundle.tier_models[cache_tier].config.quant
         if qmode != "off":
             tail += f",quant={qmode}"
         if family is not None:
             tail += f",{family}"
+        if bundle.name is not None:
+            tail += f",model={bundle.coord}"
         return f"serving.forward({bucket[0]}x{bucket[1]},b{batch}{tail})"
 
     def compiled_cost(self, bucket: Tuple[int, int], batch: int = 1,
                       tier: Optional[str] = None,
-                      family: Optional[str] = FAMILY_BASE):
+                      family: Optional[str] = FAMILY_BASE,
+                      model: Optional[str] = None):
         """The cost record for a compiled (bucket, batch) executable, or
         None (no registry / not compiled yet / analysis degraded)."""
         if self.costs is None:
             return None
-        return self.costs.get(self._cost_key(bucket, batch, tier, family))
+        return self.costs.get(self._cost_key(bucket, batch, tier, family,
+                                             model))
 
     def _forward_for(self, bucket: Tuple[int, int], batch: int = 1,
                      worker: int = 0, tier: Optional[str] = None,
-                     family: Optional[str] = FAMILY_BASE):
+                     family: Optional[str] = FAMILY_BASE,
+                     model: Optional[str] = None):
         """The compiled batch-``batch`` executable for ``bucket`` on
         ``worker``'s device — the engine-owned cache the round-6 design
         spread across per-worker InferenceRunners.  Bounded per worker at
-        ``max_cached_shapes`` (bucket, batch, tier, family) entries,
-        oldest evicted."""
-        tier = self._cache_tier(tier)
-        key = (worker, tuple(bucket), batch, tier, family)
+        ``max_cached_shapes`` (bucket, batch, tier, family, model)
+        entries, oldest evicted."""
+        tier = self._cache_tier(tier, model)
+        bundle = self._models[model]
+        key = (worker, tuple(bucket), batch, tier, family, model)
         with self._cache_lock:
             if key in self._compiled:
                 self._compiled[key] = self._compiled.pop(key)  # LRU refresh
@@ -2099,7 +2533,7 @@ class ServingEngine:
                 donate_images=self.serve_cfg.donate_buffers)
         else:
             fwd = make_forward(
-                self._tier_models[tier], self.serve_cfg.iters,
+                bundle.tier_models[tier], self.serve_cfg.iters,
                 self._fetch_jax_dtype(),
                 donate_images=self.serve_cfg.donate_buffers,
                 warm_start=(family in _WARM_FAMILIES),
@@ -2112,7 +2546,7 @@ class ServingEngine:
                 return_hidden=(family in _H_OUT_FAMILIES))
         if self.disk_cache is not None:
             fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
-                                        family)
+                                        family, model)
         else:
             # No persistent cache: the executable is built by XLA (at
             # first dispatch on the plain-jit path, inside instrument on
@@ -2120,8 +2554,9 @@ class ServingEngine:
             self.metrics.compiles_cold.inc()
             if self.costs is not None:
                 fwd = self.costs.instrument(
-                    fwd, key=self._cost_key(bucket, batch, tier, family),
-                    site="serving")
+                    fwd, key=self._cost_key(bucket, batch, tier, family,
+                                            model),
+                    site="serving", model=bundle.coord)
         with self._cache_lock:
             mine = [k for k in self._compiled if k[0] == worker]
             while len(mine) >= self.serve_cfg.max_cached_shapes:
@@ -2130,10 +2565,11 @@ class ServingEngine:
                 log.info(
                     "engine compile cache full (max_cached_shapes=%d): "
                     "evicting oldest executable for bucket %s batch %d "
-                    "tier %s family %s on worker %d — its next use "
-                    "re-pays XLA compile time",
+                    "tier %s family %s model %s on worker %d — its next "
+                    "use re-pays XLA compile time",
                     self.serve_cfg.max_cached_shapes, evicted[1],
-                    evicted[2], evicted[3], evicted[4], evicted[0])
+                    evicted[2], evicted[3], evicted[4], evicted[5],
+                    evicted[0])
                 if self.costs is not None:
                     self.costs.note_runner_eviction(
                         self._cost_key(*evicted[1:]), len(mine))
@@ -2144,7 +2580,8 @@ class ServingEngine:
 
     def _disk_key(self, bucket: Tuple[int, int], batch: int,
                   worker: int, cache_tier: Optional[str],
-                  family: Optional[str] = FAMILY_BASE) -> str:
+                  family: Optional[str] = FAMILY_BASE,
+                  model: Optional[str] = None) -> str:
         """The persistent-cache content key of one compile point: every
         coordinate that selects a distinct program, plus the device the
         serialized executable is bound to (persist.py mixes in the
@@ -2171,8 +2608,17 @@ class ServingEngine:
                 donate=self.serve_cfg.donate_buffers,
                 family=FAMILY_XL, flow_init=False,
                 mesh=self.xl.label, device=group.label)
+        bundle = self._models[model]
+        # Registered models join the key ONLY as extra kwargs (the
+        # content hash is over sorted kwargs JSON), so the implicit
+        # model's keys — no model kwargs at all — stay byte-identical
+        # to the pre-registry build (the bitwise single-model pin).
+        extra = {}
+        if bundle.name is not None:
+            extra = {"model": bundle.name,
+                     "model_version": bundle.version}
         return executable_cache_key(
-            config=self._tier_models[cache_tier].config.to_json(),
+            config=bundle.tier_models[cache_tier].config.to_json(),
             bucket=tuple(bucket), batch=int(batch),
             tier=cache_tier, iters=self.serve_cfg.iters,
             fetch_dtype=self.serve_cfg.fetch_dtype,
@@ -2188,12 +2634,14 @@ class ServingEngine:
             # explicitly — a quantized and a base executable consume
             # DIFFERENT input trees (int8 packs vs fp32 kernels) and
             # must never collide on one disk entry (tests/test_quant.py).
-            quant=self._tier_models[cache_tier].config.quant,
-            device=str(getattr(self.devices[worker], "id", worker)))
+            quant=bundle.tier_models[cache_tier].config.quant,
+            device=str(getattr(self.devices[worker], "id", worker)),
+            **extra)
 
     def _load_or_compile(self, fwd, bucket: Tuple[int, int], batch: int,
                          worker: int, cache_tier: Optional[str],
-                         family: Optional[str] = FAMILY_BASE):
+                         family: Optional[str] = FAMILY_BASE,
+                         model: Optional[str] = None):
         """The persistent-cache build path: deserialize the executable
         from disk (warm — no XLA compile paid) or AOT-compile it now and
         store it for the next boot (cold).  Either way the cost registry
@@ -2203,25 +2651,30 @@ class ServingEngine:
         the dispatch path down."""
         import jax
 
-        disk_key = self._disk_key(bucket, batch, worker, cache_tier, family)
+        bundle = self._models[model]
+        disk_key = self._disk_key(bucket, batch, worker, cache_tier,
+                                  family, model)
         t0 = time.perf_counter()
         exe = self.disk_cache.load(disk_key)
         if exe is not None:
             self.metrics.compiles_warm.inc()
-            log.info("bucket %s batch %d tier %s family %s worker %d: "
-                     "executable restored from persistent cache in %.3fs",
-                     bucket, batch, cache_tier, family, worker,
-                     time.perf_counter() - t0)
+            log.info("bucket %s batch %d tier %s family %s model %s "
+                     "worker %d: executable restored from persistent "
+                     "cache in %.3fs",
+                     bucket, batch, cache_tier, family, bundle.coord,
+                     worker, time.perf_counter() - t0)
             if self.costs is not None:
                 self.costs.record(
-                    self._cost_key(bucket, batch, cache_tier, family),
-                    "serving", time.perf_counter() - t0, compiled=exe)
+                    self._cost_key(bucket, batch, cache_tier, family,
+                                   model),
+                    "serving", time.perf_counter() - t0, compiled=exe,
+                    model=bundle.coord)
             return exe
         aval = jax.ShapeDtypeStruct((batch, bucket[0], bucket[1], 3),
                                     np.uint8)
         avals = [aval, aval]
         tier_cfg = (self.xl.model.config if family == FAMILY_XL
-                    else self._tier_models[cache_tier].config)
+                    else bundle.tier_models[cache_tier].config)
         if family in _WARM_FAMILIES:
             f = tier_cfg.downsample_factor
             avals.append(jax.ShapeDtypeStruct(
@@ -2231,7 +2684,8 @@ class ServingEngine:
         if family in _CTX_REUSE_FAMILIES:
             avals.append(self._ctx_avals(tier_cfg, bucket, batch))
         try:
-            compiled = fwd.lower(self._vars_for(worker, cache_tier),
+            compiled = fwd.lower(self._vars_for(worker, cache_tier,
+                                                model),
                                  *avals).compile()
         except Exception:
             log.warning("AOT compile for the persistent cache failed; "
@@ -2241,21 +2695,23 @@ class ServingEngine:
             if self.costs is not None:
                 return self.costs.instrument(
                     fwd, key=self._cost_key(bucket, batch, cache_tier,
-                                            family),
-                    site="serving")
+                                            family, model),
+                    site="serving", model=bundle.coord)
             return fwd
         compile_s = time.perf_counter() - t0
         self.metrics.compiles_cold.inc()
         if self.costs is not None:
             self.costs.record(
-                self._cost_key(bucket, batch, cache_tier, family),
-                "serving", compile_s, compiled=compiled)
+                self._cost_key(bucket, batch, cache_tier, family, model),
+                "serving", compile_s, compiled=compiled,
+                model=bundle.coord)
         self.disk_cache.store(
             disk_key, compiled,
             meta={"bucket": list(bucket), "batch": int(batch),
                   "tier": cache_tier, "family": family,
                   "iters": self.serve_cfg.iters,
                   "quant": tier_cfg.quant,
+                  "model": bundle.coord,
                   "mesh": (self.xl.label if family == FAMILY_XL
                            else None),
                   "fetch_dtype": self.serve_cfg.fetch_dtype,
@@ -2274,7 +2730,8 @@ class ServingEngine:
 
     def prewarm(self, raw_hw: Tuple[int, int],
                 batch_sizes: Optional[Sequence[int]] = None,
-                tiers: Optional[Sequence[Optional[str]]] = None) -> None:
+                tiers: Optional[Sequence[Optional[str]]] = None,
+                models: Optional[Sequence[Optional[str]]] = None) -> None:
         """Compile + warm the whole bucket ladder for one raw shape on
         every worker: each configured batch size dispatches once with
         zero images, so the first real requests at this shape hit warm
@@ -2282,7 +2739,10 @@ class ServingEngine:
         ladder rung's cost record at boot).  With latency tiers
         configured, every tier's executable family is warmed (fixed-depth
         tiers share the base executables, so the ladder compiles once per
-        DISTINCT program, not once per tier name)."""
+        DISTINCT program, not once per tier name).  ``models`` limits
+        the pass to specific registered models (None = every served
+        model, implicit first) — the hot-swap path warms just the new
+        arrival."""
         import jax
 
         h, w = int(raw_hw[0]), int(raw_hw[1])
@@ -2290,54 +2750,63 @@ class ServingEngine:
         if self._xl_routes((hp, wp)):
             # This bucket's traffic dispatches on the xl mesh groups —
             # warm THAT surface (and only it; the solo ladder at this
-            # size would compile programs no request runs).
-            self._prewarm_xl((hp, wp), batch_sizes)
+            # size would compile programs no request runs).  Implicit
+            # model only: named models never route xl.
+            if models is None or None in models:
+                self._prewarm_xl((hp, wp), batch_sizes)
             return
         sizes = tuple(batch_sizes) if batch_sizes else self.queue.sizes
-        if tiers is None:
-            cache_tiers = self._distinct_cache_tiers()
-        else:
-            # Distinct executable families only: "quality" and the base
-            # path normalize to the same cache key.
-            cache_tiers = sorted({self._cache_tier(t) for t in tiers},
-                                 key=lambda t: (t is not None, t or ""))
-        for widx, dev in enumerate(self.devices):
-            for tier in cache_tiers:
-                for n in sizes:
-                    for family in self._families():
-                        fwd = self._forward_for((hp, wp), n, worker=widx,
-                                                tier=tier, family=family)
-                        zeros = np.zeros((n, hp, wp, 3), np.uint8)
-                        args = [self._vars_for(widx, tier),
-                                jax.device_put(zeros, dev),
-                                jax.device_put(zeros.copy(), dev)]
-                        tier_cfg = self._tier_models[tier].config
-                        if family in _WARM_FAMILIES:
-                            f = tier_cfg.downsample_factor
-                            args.append(jax.device_put(
-                                np.zeros((n, hp // f, wp // f),
-                                         np.float32), dev))
-                        if family in _H_IN_FAMILIES:
-                            import jax.tree_util as jtu
-                            args.append(jtu.tree_map(
-                                lambda s: jax.device_put(
-                                    np.zeros(s.shape, s.dtype), dev),
-                                self._hidden_avals(tier_cfg, (hp, wp),
-                                                   n)))
-                        if family in _CTX_REUSE_FAMILIES:
-                            import jax.tree_util as jtu
-                            ctx_zeros = jtu.tree_map(
-                                lambda s: jax.device_put(
-                                    np.zeros(s.shape, s.dtype), dev),
-                                self._ctx_avals(tier_cfg, (hp, wp), n))
-                            args.append(ctx_zeros)
-                        out = fwd(*args)
-                        jax.block_until_ready(out)
-                        self._note_warm(widx, (hp, wp), n, tier, family)
-        log.info("prewarmed bucket %dx%d batch sizes %s (%d tier "
-                 "famil%s x %d program variant(s)) on %d worker(s)",
-                 hp, wp, sizes, len(cache_tiers),
-                 "y" if len(cache_tiers) == 1 else "ies",
+        model_names = (list(models) if models is not None
+                       else self._registered_names())
+        for mname in model_names:
+            if tiers is None:
+                cache_tiers = self._distinct_cache_tiers(mname)
+            else:
+                # Distinct executable families only: "quality" and the
+                # base path normalize to the same cache key.
+                cache_tiers = sorted(
+                    {self._cache_tier(t, mname) for t in tiers},
+                    key=lambda t: (t is not None, t or ""))
+            bundle = self._models[mname]
+            for widx, dev in enumerate(self.devices):
+                for tier in cache_tiers:
+                    for n in sizes:
+                        for family in self._families():
+                            fwd = self._forward_for(
+                                (hp, wp), n, worker=widx,
+                                tier=tier, family=family, model=mname)
+                            zeros = np.zeros((n, hp, wp, 3), np.uint8)
+                            args = [self._vars_for(widx, tier, mname),
+                                    jax.device_put(zeros, dev),
+                                    jax.device_put(zeros.copy(), dev)]
+                            tier_cfg = bundle.tier_models[tier].config
+                            if family in _WARM_FAMILIES:
+                                f = tier_cfg.downsample_factor
+                                args.append(jax.device_put(
+                                    np.zeros((n, hp // f, wp // f),
+                                             np.float32), dev))
+                            if family in _H_IN_FAMILIES:
+                                import jax.tree_util as jtu
+                                args.append(jtu.tree_map(
+                                    lambda s: jax.device_put(
+                                        np.zeros(s.shape, s.dtype), dev),
+                                    self._hidden_avals(tier_cfg, (hp, wp),
+                                                       n)))
+                            if family in _CTX_REUSE_FAMILIES:
+                                import jax.tree_util as jtu
+                                ctx_zeros = jtu.tree_map(
+                                    lambda s: jax.device_put(
+                                        np.zeros(s.shape, s.dtype), dev),
+                                    self._ctx_avals(tier_cfg, (hp, wp), n))
+                                args.append(ctx_zeros)
+                            out = fwd(*args)
+                            jax.block_until_ready(out)
+                            self._note_warm(widx, (hp, wp), n, tier,
+                                            family, mname)
+        log.info("prewarmed bucket %dx%d batch sizes %s (%d model(s) x "
+                 "tier families x %d program variant(s)) on %d "
+                 "worker(s)",
+                 hp, wp, sizes, len(model_names),
                  len(self._families()), len(self.devices))
 
     def _prewarm_xl(self, bucket: Tuple[int, int],
@@ -2564,8 +3033,13 @@ class ServingEngine:
         t_pickup = time.monotonic()
         waits = [t_pickup - r.t_enqueue for r in batch]
         bucket = batch[0].bucket
-        tier = batch[0].tier       # queue groups by (bucket, tier, family)
+        # The queue groups by (bucket, tier, family, model): every
+        # member of this chunk shares all four coordinates.
+        tier = batch[0].tier
         family = batch[0].family
+        model = batch[0].model
+        bundle = self._models[model]
+        cache_tier = self._cache_tier(tier, model)
         n = len(batch)
         xl = family == FAMILY_XL
         if xl:
@@ -2602,12 +3076,12 @@ class ServingEngine:
             # to solo inference; n > 1 amortizes the fixed per-dispatch
             # work across a real batch axis with zero filler frames.
             fwd = self._forward_for(bucket, n, worker=widx, tier=tier,
-                                    family=family)
+                                    family=family, model=model)
             adaptive = False if xl else early_exit_enabled(
-                self._tier_models[self._cache_tier(tier)].config)
+                bundle.tier_models[cache_tier].config)
             p1 = np.stack([r.payload.left for r in batch])
             p2 = np.stack([r.payload.right for r in batch])
-            args = [self._vars_for(widx, self._cache_tier(tier)),
+            args = [self._vars_for(widx, cache_tier, model),
                     jax.device_put(p1, device),
                     jax.device_put(p2, device)]
             if family in _WARM_FAMILIES:
@@ -2727,12 +3201,18 @@ class ServingEngine:
         # (cost_report --observed_iters).
         if self._mfu is not None:
             rec = self.compiled_cost(bucket, batch=n, tier=tier,
-                                     family=family)
+                                     family=family, model=model)
             if rec is not None and rec.flops:
                 self.metrics.dispatched_flops.inc(rec.flops)
                 self._mfu.note(rec.flops)
         self.metrics.note_batch_done()
-        self._note_warm(widx, bucket, n, self._cache_tier(tier), family)
+        if model is not None:
+            # Per-model request accounting (named models only: the
+            # implicit model's /metrics stay byte-identical to pre-
+            # registry builds).
+            self.metrics.observe_model_request(bundle.name, bundle.version,
+                                               n_requests=n)
+        self._note_warm(widx, bucket, n, cache_tier, family, model)
         for i, (r, fp, wait) in enumerate(zip(batch, flows_padded, waits)):
             exemplar = r.trace.trace_id if r.trace is not None else None
             p_respond = time.perf_counter() if exemplar is not None else 0.0
@@ -2772,7 +3252,9 @@ class ServingEngine:
                 ctx_cached=(family in _CTX_REUSE_FAMILIES),
                 ctx=ctx_i,
                 hidden=hidden_i,
-                warm_hidden=(family in _H_IN_FAMILIES)))
+                warm_hidden=(family in _H_IN_FAMILIES),
+                model=bundle.name,
+                model_version=bundle.version))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

@@ -11,6 +11,9 @@ See docs/architecture.md §Fleet.
                    drain handoff, HA roles, xl-capability routing
 * ``ledger``     — the HA pair's fenced lease + append-only ledger
 * ``autoscaler`` — the pressure -> fleet-size control loop + launchers
+* ``rollout``    — canary/shadow rollout policy (deterministic traffic
+                   split onto a registered model version + hysteresis
+                   auto-demotion; round 21 multi-model serving)
 * ``http``       — the router's HTTP front end (``raft-route``)
 """
 
@@ -26,6 +29,8 @@ from raft_stereo_tpu.serving.fleet.ledger import FleetLedger
 from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
                                                    ReplicaUnreachable)
 from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
+from raft_stereo_tpu.serving.fleet.rollout import (RolloutConfig,
+                                                   RolloutPolicy)
 from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
                                                   NoReplicasAvailable,
                                                   RouterConfig, SessionLost,
@@ -37,4 +42,4 @@ __all__ = ["DEFAULT_VNODES", "HashRing", "Replica", "ReplicaHealth",
            "RouterHTTPServer", "make_router_handler",
            "retry_after_jittered", "FleetLedger", "Autoscaler",
            "AutoscaleConfig", "ReplicaLauncher", "LocalProcessLauncher",
-           "serve_argv_template"]
+           "serve_argv_template", "RolloutConfig", "RolloutPolicy"]

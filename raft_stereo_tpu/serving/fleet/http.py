@@ -16,7 +16,14 @@ surface:
   (``fleet_replicas_ready``, ``fleet_failovers_total``,
   ``fleet_sessions_lost_total``, routing-decision counters).
 * ``GET /fleet`` — full JSON status: replica states, ring membership,
-  session ledger sizes, brownout level, recent transitions.
+  session ledger sizes, brownout level, rollout policy, recent
+  transitions.
+* ``GET|POST /admin/rollout`` — the canary/shadow rollout policy
+  (fleet/rollout.py): ``{"action": "set", "model": "name@version",
+  "fraction": F, "shadow_fraction": S}`` arms a deterministic traffic
+  split onto a registered canary version; ``{"action": "clear"}``
+  disarms; GET returns the live status (fractions, shadow-EPE window,
+  demotion state).
 
 Fleet-level typed errors (these are the ONLY responses the router
 originates on the request path):
@@ -183,11 +190,46 @@ def make_router_handler(router: FleetRouter):
                     "total_replicas": status["total"]})
             elif path == "/fleet":
                 self._reply_json(200, router.fleet_status())
+            elif path == "/admin/rollout":
+                self._reply_json(200, router.rollout.status())
             else:
                 self._reply_json(404, {"error": f"no route {path!r}"})
 
+        def _handle_rollout_post(self):
+            """``POST /admin/rollout`` — arm/disarm the canary split
+            (fleet/rollout.py): ``{"action": "set", "model":
+            "name@version", "fraction": 0.05, "shadow_fraction": 0.0}``
+            arms (re-arming clears a previous demotion — an operator
+            decision, never automatic); ``{"action": "clear"}``
+            disarms.  200 with the policy status either way."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length \
+                    else {}
+                action = body["action"]
+                if action == "set":
+                    out = router.rollout.set_canary(
+                        str(body["model"]),
+                        float(body["fraction"]),
+                        shadow_fraction=float(
+                            body.get("shadow_fraction", 0.0)))
+                elif action == "clear":
+                    out = router.rollout.clear_canary()
+                else:
+                    raise ValueError(f"unknown action {action!r}")
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply_json(400, {
+                    "error": 'need a JSON body {"action": "set"|"clear",'
+                             ' ...}',
+                    "detail": str(e)})
+                return
+            self._reply_json(200, {"status": "ok", "rollout": out})
+
         def do_POST(self):
             url = urlparse(self.path)
+            if url.path == "/admin/rollout":
+                self._handle_rollout_post()
+                return
             if (url.path != "/v1/disparity"
                     and _stream_session_id(url.path, self.headers)
                     is None):

@@ -89,6 +89,8 @@ from raft_stereo_tpu.serving.fleet.ledger import FleetLedger
 from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
                                                    ReplicaUnreachable)
 from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
+from raft_stereo_tpu.serving.fleet.rollout import (RolloutConfig,
+                                                   RolloutPolicy)
 from raft_stereo_tpu.telemetry.registry import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -229,6 +231,7 @@ class FleetRouter:
     def __init__(self, replicas: Dict[str, str],
                  cfg: RouterConfig = RouterConfig(),
                  registry: Optional[MetricsRegistry] = None,
+                 rollout_cfg: Optional[RolloutConfig] = None,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -330,6 +333,12 @@ class FleetRouter:
                 self.ledger.acquire()
                 self._replay_ledger()
         self.active_gauge.set(1 if self.active else 0)
+        # Canary/shadow rollout policy (fleet/rollout.py): always
+        # present, disarmed by default — a disarmed policy makes zero
+        # routing decisions, so the pass-through contract holds until
+        # an operator arms it (--canary / POST /admin/rollout).
+        self.rollout = RolloutPolicy(rollout_cfg or RolloutConfig(),
+                                     registry=r, clock=clock)
         self._routed_lock = threading.Lock()
         self._routed_by_kind: Dict[str, object] = {}
         self._per_replica_lock = threading.Lock()
@@ -379,6 +388,13 @@ class FleetRouter:
                 self._ha_tick()
             except Exception:  # pragma: no cover — loop must not die
                 log.exception("fleet HA tick failed")
+            try:
+                # Hysteresis dwell: a sustained regression verdict must
+                # demote even when no new evidence arrives to trigger
+                # the inline poll.
+                self.rollout.poll()
+            except Exception:  # pragma: no cover — loop must not die
+                log.exception("rollout poll failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -808,6 +824,18 @@ class FleetRouter:
         return any(k.lower() == "x-tier" and v.strip() == "xl"
                    for k, v in headers)
 
+    @staticmethod
+    def _names_model(path_qs: str,
+                     headers: Sequence[Tuple[str, str]]) -> bool:
+        """Whether the CLIENT already picked a model (``?model=`` or
+        ``X-Model``) — the rollout policy never overrides an explicit
+        choice, it only splits the default-model traffic."""
+        query = parse_qs(urlparse(path_qs).query)
+        if query.get("model"):
+            return True
+        return any(k.lower() == "x-model" and v.strip()
+                   for k, v in headers)
+
     def forward_stateless(self, method: str, path_qs: str,
                           body: Optional[bytes],
                           headers: Sequence[Tuple[str, str]]
@@ -820,8 +848,28 @@ class FleetRouter:
         error.  HTTP error responses are answers, not failures — they
         forward verbatim, no retry.  Requests naming the xl tier route
         only to xl-capable replicas (typed ``XlUnavailable`` when the
-        rotation has none)."""
+        rotation has none).
+
+        With a canary armed (fleet/rollout.py) a deterministic hash of
+        the body routes the configured fraction of requests that named
+        NO model themselves to the canary version (``X-Model`` injected
+        before forwarding), and a sampled remainder is mirrored to it
+        fire-and-forget for shadow comparison — the client always gets
+        the primary answer."""
         require_xl = self._wants_xl(path_qs, headers)
+        # Rollout split: inference POSTs only, and never a request that
+        # already named a model.
+        canary: Optional[str] = None
+        shadow = False
+        if (method == "POST" and body
+                and urlparse(path_qs).path == "/v1/disparity"
+                and self.rollout.active
+                and not self._names_model(path_qs, headers)):
+            canary = self.rollout.assign(body)
+            if canary is not None:
+                headers = list(headers) + [("X-Model", canary)]
+            else:
+                shadow = self.rollout.wants_shadow(body)
         tried: List[str] = []
         last: Optional[ReplicaUnreachable] = None
         for attempt in range(self.cfg.route_retries):
@@ -852,11 +900,82 @@ class FleetRouter:
                             method, path_qs, rep.name, attempt + 1)
                 continue
             self._note_routed("stateless", rep.name)
+            if canary is not None:
+                # 5xx means the canary arm failed the request; a 4xx is
+                # the client's fault on either arm and says nothing
+                # about the weights.
+                self.rollout.note_canary_result(status < 500)
+            elif shadow and status == 200:
+                self._mirror_shadow(path_qs, body, headers, payload)
             return status, h, payload
+        if canary is not None:
+            # The canary arm never answered at all: transport-level
+            # evidence against it (shared with the fleet-health path —
+            # a dead fleet demotes nothing by itself thanks to
+            # min_samples).
+            self.rollout.note_canary_result(False)
         self.unroutable.inc()
         raise NoReplicasAvailable(
             f"all {len(tried)} dispatch attempt(s) hit transport "
             f"failures (tried {tried}): {last}")
+
+    # ------------------------------------------------------- shadow mirror
+    def _mirror_shadow(self, path_qs: str, body: bytes,
+                       headers: Sequence[Tuple[str, str]],
+                       primary_payload: bytes) -> None:
+        """Fire-and-forget mirror of one baseline request to the canary
+        version on a short-lived thread: the shadow answer is compared
+        against the primary's disparity (mean EPE divergence), recorded
+        into the rollout policy's regression window, and DROPPED —
+        never returned, never retried, never allowed to fail the
+        client's request."""
+        threading.Thread(
+            target=self._shadow_once,
+            args=(path_qs, body, list(headers), primary_payload),
+            daemon=True, name="fleet-shadow").start()
+
+    def _shadow_once(self, path_qs: str, body: bytes,
+                     headers: List[Tuple[str, str]],
+                     primary_payload: bytes) -> None:
+        try:
+            model = self.rollout.canary_model()
+            if model is None:
+                return
+            fwd = [(k, v) for k, v in headers
+                   if k.lower() != "x-model"]
+            fwd.append(("X-Model", model[0]))
+            rep = self.pick_stateless()
+            status, _h, payload = rep.forward(
+                "POST", path_qs, body, fwd, self.cfg.request_timeout_s)
+            if status != 200:
+                self.rollout.note_canary_result(status < 500)
+                return
+            epe = self._payload_epe(primary_payload, payload)
+            if epe is not None:
+                self.rollout.note_shadow_epe(epe)
+        except (ReplicaUnreachable, NoReplicasAvailable):
+            pass        # no capacity for shadows is not canary evidence
+        except Exception:  # pragma: no cover — mirror must never raise
+            log.exception("shadow mirror failed")
+
+    @staticmethod
+    def _payload_epe(primary: bytes, shadow: bytes) -> Optional[float]:
+        """Mean |EPE| between two ``.npy`` disparity payloads; None when
+        either payload is not a comparable array (png responses, shape
+        mismatch) — the compare is evidence, not a contract."""
+        import io
+
+        import numpy as np
+        try:
+            a = np.load(io.BytesIO(primary), allow_pickle=False)
+            b = np.load(io.BytesIO(shadow), allow_pickle=False)
+        except Exception:
+            return None
+        if getattr(a, "shape", None) != getattr(b, "shape", None) \
+                or a.shape == ():
+            return None
+        return float(np.mean(np.abs(np.asarray(a, np.float32)
+                                    - np.asarray(b, np.float32))))
 
     def _forward_session_once(self, session_id: str, method: str,
                               path_qs: str, body: Optional[bytes],
@@ -1094,5 +1213,6 @@ class FleetRouter:
                 "role": ("single" if self.ledger is None
                          else "primary" if self.active else "standby"),
                 "epoch": self.ledger.epoch if self.ledger else None,
+                "rollout": self.rollout.status(),
                 "transitions": list(self._transitions[-50:]),
             }

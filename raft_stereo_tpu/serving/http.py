@@ -58,6 +58,20 @@ Endpoints:
   from the persistent executable cache); 503 with warm progress before
   that.  Pointing traffic here keeps cold pods out of rotation while
   they prewarm (docs/architecture.md §Resilience).
+* ``?model=`` / ``X-Model`` (both request kinds) — pick a REGISTERED
+  model version (serving/models.py); absent means the engine default
+  (byte-identical to the pre-registry single-model server).  Unknown
+  names get a typed 404 ``{"error": "model_unknown"}``; responses
+  served by a named model carry ``X-Model`` / ``X-Model-Version``.
+  Session frames pin the model their stream started on — naming a
+  DIFFERENT model mid-stream is a 400.
+* ``GET /admin/models`` — registry inventory (default pointer,
+  registered versions, per-model in-flight counts); ``POST
+  /admin/models`` — live hot swap: ``{"action": "register", "model":
+  "name@version", "default": true}`` loads + prewarms + flips,
+  ``{"action": "retire", "model": "name"}`` drains + evicts (409 on
+  the default, 504 on drain timeout), ``{"action": "set_default",
+  "model": name|null}`` flips the pointer atomically.
 * ``POST /admin/brownout`` — fleet control plane (serving/fleet/):
   ``{"level": N}`` sets the brownout degradation FLOOR the router
   computed from aggregate fleet pressure, so every replica steps down
@@ -94,6 +108,7 @@ import numpy as np
 
 from raft_stereo_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
                                              RequestPoisoned)
+from raft_stereo_tpu.serving.models import ModelStoreError, ModelUnknown
 from raft_stereo_tpu.serving.service import StereoService
 from raft_stereo_tpu.serving.sessions import SessionExpired, SessionsDisabled
 from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
@@ -230,12 +245,23 @@ def make_handler(service: StereoService,
                     "session_hidden": service.serve_cfg.session_hidden,
                     "edf_scheduler": service.serve_cfg.edf_scheduler,
                     "devices": len(service.devices),
-                    "xl": service.xl_status()})
+                    "xl": service.xl_status(),
+                    # Registry inventory, only once a named model exists
+                    # (a single-model replica's /healthz body is pinned
+                    # byte-identical to pre-registry builds).
+                    **({"models": service.models_status()}
+                       if (service.default_model is not None
+                           or len(service._models) > 1) else {})})
             elif path == "/readyz":
                 status = service.warm_status()
                 status["status"] = ("ready" if status["ready"]
                                     else "warming")
                 self._reply_json(200 if status["ready"] else 503, status)
+            elif path == "/admin/models":
+                # Registry inventory: the default pointer plus every
+                # registered version's coordinate / retiring flag /
+                # in-flight count (serving/engine.py models_status).
+                self._reply_json(200, service.models_status())
             elif path == "/admin/handoff":
                 # The drain handoff manifest (round 18): after a
                 # graceful SIGTERM published the session blob, the
@@ -281,10 +307,83 @@ def make_handler(service: StereoService,
             self._reply_json(200, {"status": "ok", "floor": level,
                                    "level": effective})
 
+        def _handle_models_post(self):
+            """``POST /admin/models`` — live model lifecycle (round 21
+            hot swap; serving/models.py + engine registry):
+
+            * ``{"action": "register", "model": "name[@version]",
+              "default": bool, "prewarm": bool}`` — load + verify the
+              version from the artifact store, prewarm its ladder
+              (readiness gate closed until warm), optionally flip the
+              default pointer.  200 with the registration status.
+            * ``{"action": "retire", "model": "name"}`` — drain the
+              model's in-flight dispatches, then evict its pytree and
+              executables.  409 while it is the default.
+            * ``{"action": "set_default", "model": "name"|null}`` —
+              atomic default-pointer flip (null restores the implicit
+              constructor model).
+
+            Typed errors: 404 ``model_unknown``; 409 ``model_store`` /
+            ``retire_default``; 504 ``retire_timeout``."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length)) if length \
+                    else {}
+                action = body["action"]
+                if action not in ("register", "retire", "set_default"):
+                    raise ValueError(f"unknown action {action!r}")
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply_json(400, {
+                    "error": 'need a JSON body {"action": '
+                             '"register"|"retire"|"set_default", ...}',
+                    "detail": str(e)})
+                return
+            try:
+                if action == "register":
+                    out = service.register_model(
+                        str(body["model"]),
+                        set_default=bool(body.get("default", False)),
+                        prewarm=bool(body.get("prewarm", True)))
+                elif action == "retire":
+                    timeout = float(body.get("timeout_s", 30.0))
+                    service.retire_model(str(body["model"]),
+                                         timeout=timeout)
+                    out = {"model": body["model"], "retired": True}
+                else:
+                    name = body.get("model")
+                    service.set_default_model(
+                        str(name) if name is not None else None)
+                    out = {"default": name}
+            except ModelUnknown as e:
+                self._reply_json(404, {"error": "model_unknown",
+                                       "model": e.model, "known": e.known,
+                                       "detail": str(e)})
+                return
+            except ModelStoreError as e:
+                self._reply_json(409, {"error": "model_store",
+                                       "detail": str(e)})
+                return
+            except TimeoutError as e:
+                self._reply_json(504, {"error": "retire_timeout",
+                                       "detail": str(e)})
+                return
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply_json(400, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                self._reply_json(409, {"error": "retire_default",
+                                       "detail": str(e)})
+                return
+            self._reply_json(200, {"status": "ok", **out,
+                                   "models": service.models_status()})
+
         def do_POST(self):
             url = urlparse(self.path)
             if url.path == "/admin/brownout":
                 self._handle_brownout_post()
+                return
+            if url.path == "/admin/models":
+                self._handle_models_post()
                 return
             if url.path == "/debug/trace":
                 handle_trace_post(self, trace, self._reply_json)
@@ -334,6 +433,10 @@ def make_handler(service: StereoService,
                             "mesh-sharded program")
                 elif tier is not None:
                     service.resolve_tier(tier)  # 400 on unknown tiers
+                # ``?model=`` / ``X-Model`` picks a REGISTERED model
+                # (serving/models.py); absent means the engine default.
+                model = query.get("model", [None])[0] or \
+                    self.headers.get("X-Model")
                 degradable = self.headers.get("X-No-Degrade") is None
             except (ValueError, KeyError, OSError) as e:
                 self._reply_json(400, {"error": str(e)})
@@ -342,13 +445,22 @@ def make_handler(service: StereoService,
                 if session_id is not None:
                     result = service.infer_session(
                         session_id, left, right, deadline_ms=deadline_ms,
-                        tier=tier, degradable=degradable,
+                        tier=tier, degradable=degradable, model=model,
                         handoff_key=self.headers.get(
                             "X-Handoff-Artifact"))
                 else:
                     result = service.infer(left, right,
                                            deadline_ms=deadline_ms,
-                                           tier=tier, degradable=degradable)
+                                           tier=tier, degradable=degradable,
+                                           model=model)
+            except ModelUnknown as e:
+                # Typed admission contract: the request named a model
+                # this replica does not serve — 404, machine-readable.
+                self._reply_json(404, {"error": "model_unknown",
+                                       "model": e.model,
+                                       "known": e.known,
+                                       "detail": str(e)})
+                return
             except SessionsDisabled as e:
                 self._reply_json(400, {"error": "sessions_disabled",
                                        "detail": str(e)})
@@ -385,6 +497,12 @@ def make_handler(service: StereoService,
                                        "attempts": e.attempts,
                                        "detail": str(e)})
                 return
+            except ValueError as e:
+                # Engine-side admission rejections that only trigger at
+                # submit time: xl with a named model, a session's
+                # mid-stream model switch.
+                self._reply_json(400, {"error": str(e)})
+                return
             except Exception as e:  # noqa: BLE001 — model/device failure
                 log.exception("inference failed")
                 self._reply_json(500, {"error": str(e)})
@@ -408,6 +526,11 @@ def make_handler(service: StereoService,
             if result.degraded:
                 headers.append(("X-Degraded",
                                 f"{result.requested_tier}->{result.tier}"))
+            if result.model is not None:
+                # Named-model responses carry the exact version that
+                # served them — the canary comparator keys on this.
+                headers.append(("X-Model", result.model))
+                headers.append(("X-Model-Version", result.model_version))
             if result.session_id is not None:
                 headers.append(("X-Session-Id", result.session_id))
                 headers.append(("X-Frame-Index", str(result.frame_index)))

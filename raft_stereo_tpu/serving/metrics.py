@@ -225,6 +225,11 @@ class ServingMetrics:
             buckets=FRAME_DELTA_BUCKETS)
         self._session_frame_lock = threading.Lock()
         self._session_frames_by_mode: Dict[str, Counter] = {}
+        # Per-model request accounting (round 21 multi-model serving).
+        # Lazily labeled like every family here: a single-model engine
+        # never touches it, so its /metrics stay byte-identical.
+        self._model_req_lock = threading.Lock()
+        self._model_req_by_coord: Dict[Tuple[str, str], Counter] = {}
         self._bucket_lock = threading.Lock()
         self._bucket_px: Dict[str, Tuple[Counter, Counter]] = {}
         # Adaptive early-exit accounting (serving/engine.py per-tier
@@ -391,6 +396,34 @@ class ServingMetrics:
                     labels={"reason": reason})
                 self._handoff_skip_by_reason[reason] = c
         c.inc(n)
+
+    def observe_model_request(self, model: str, version: str,
+                              n_requests: int = 1) -> None:
+        """Count ``n_requests`` completed requests against one registered
+        model version (``serve_model_requests_total{model=,version=}``) —
+        the canary/shadow rollout's per-version traffic signal.  Only
+        NAMED models land here; the implicit constructor model keeps the
+        pre-registry metric surface."""
+        if n_requests <= 0:
+            return
+        with self._model_req_lock:
+            c = self._model_req_by_coord.get((model, version))
+            if c is None:
+                c = self.registry.counter(
+                    "serve_model_requests_total",
+                    "completed requests by registered model version "
+                    "(named models only; the implicit model is not "
+                    "labeled)",
+                    labels={"model": model, "version": version})
+                self._model_req_by_coord[(model, version)] = c
+        c.inc(n_requests)
+
+    def model_requests(self, model: str, version: str) -> int:
+        """Completed-request count for one model version (0 before the
+        first) — what model_smoke asserts routing on."""
+        with self._model_req_lock:
+            c = self._model_req_by_coord.get((model, version))
+        return 0 if c is None else c.value
 
     def handoff_skips(self, reason: str) -> int:
         """Skipped-session count for one reason (0 before the first)."""

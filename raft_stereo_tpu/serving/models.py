@@ -1,0 +1,263 @@
+"""Model registry: versioned weight pytrees in the shared artifact store.
+
+The engine historically served exactly one variables pytree per process,
+forever — the weights rode the constructor and nothing could change them
+short of a restart (ROADMAP item 5).  This module is the identity layer
+that lifts that: a **ModelStore** keeps versioned checkpoints in the
+SAME artifact store the compiled executables already share
+(``models/<name>/<version>`` next to persist.py's ``<key[:2]>/*.jaxexe``
+entries and the ``sessions/`` handoff namespace), and a **RegisteredModel**
+is one loaded version the engine's registry threads through dispatch,
+compile keys, prewarm, and telemetry.
+
+Store layout — one directory per version, written by the SAME atomic
+r20 deep-validation machinery the train loop checkpoints with
+(training/checkpoint.py): ``config.json`` + orbax ``state/`` + a
+per-file SHA-256 ``MANIFEST`` sealed by the ``COMMIT`` marker, staged in
+a same-filesystem tmp dir and ``os.replace``d into place.  A version is
+IMMUTABLE once published (re-publishing an existing version is a typed
+error unless forced); a flipped byte anywhere in the blob fails
+``verify`` instead of serving garbage weights.
+
+    models/
+      kitti/
+        v1/   config.json  state/  MANIFEST  COMMIT
+        v2/   ...
+
+Identity rules the rest of the subsystem builds on:
+
+* A model COORDINATE is ``name@version`` (``parse_model_spec``).  Names
+  and versions are path-safe tokens — the store never joins untrusted
+  path segments.
+* The engine's implicit constructor model has NO coordinate (``None``):
+  every key, metric, and wire field it touches is byte-identical to the
+  pre-registry build.  The model coordinate only exists where a named
+  model does.
+* ``ModelUnknown`` is the typed admission error (HTTP 404
+  ``model_unknown``) — same contract as the tier ladder's unknown-tier
+  400, one level up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+MODELS_SUBDIR = "models"
+
+# Path-safe model name / version tokens: the store builds filesystem
+# paths from them, so they must never carry separators or traversal.
+_TOKEN_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+class ModelUnknown(KeyError):
+    """A request named a model this engine does not serve (HTTP 404,
+    ``{"error": "model_unknown"}``) — the model-layer sibling of the
+    tier ladder's unknown-tier ValueError."""
+
+    def __init__(self, model: str, known: List[str]):
+        super().__init__(
+            f"unknown model {model!r}: this engine serves "
+            f"{sorted(known) or '(no registered models)'}")
+        self.model = model
+        self.known = sorted(known)
+
+    def __str__(self) -> str:  # KeyError quotes its arg; keep it readable
+        return self.args[0]
+
+
+class ModelStoreError(RuntimeError):
+    """Typed store failure: missing/torn version, hash mismatch, or an
+    immutability violation (publishing over an existing version)."""
+
+
+class ModelVersionExists(ModelStoreError):
+    """Publish refused: the version already exists and is complete —
+    versions are immutable; publish a NEW version instead."""
+
+
+def _check_token(kind: str, value: str) -> str:
+    if not isinstance(value, str) or not _TOKEN_RE.match(value):
+        raise ValueError(
+            f"model {kind} {value!r} must match {_TOKEN_RE.pattern} "
+            f"(path-safe token; the store builds paths from it)")
+    return value
+
+
+def parse_model_spec(spec: str) -> Tuple[str, Optional[str]]:
+    """``"name@version"`` -> (name, version); bare ``"name"`` -> (name,
+    None) — the caller resolves None to the store's latest version."""
+    if "@" in spec:
+        name, _, version = spec.partition("@")
+        return _check_token("name", name), _check_token("version", version)
+    return _check_token("name", spec), None
+
+
+def model_coord(name: str, version: str) -> str:
+    """The canonical ``name@version`` coordinate every key and metric
+    label carries."""
+    return f"{name}@{version}"
+
+
+@dataclasses.dataclass
+class RegisteredModel:
+    """One loaded model version: the identity coordinate plus the host
+    pytree the engine builds its per-worker/per-tier state from.  The
+    registry is architecture-agnostic — the version carries its OWN
+    ``RaftStereoConfig``, so a registered model may differ from the
+    process default in any architecture knob."""
+
+    name: str
+    version: str
+    config: Any                      # RaftStereoConfig
+    variables: Any                   # host pytree ({"params": ...})
+    metadata: Optional[Dict[str, Any]] = None
+
+    @property
+    def coord(self) -> str:
+        return model_coord(self.name, self.version)
+
+
+class ModelStore:
+    """The ``models/<name>/<version>`` namespace of the shared artifact
+    store.  Thread-safe; every version directory is written atomically
+    by training/checkpoint.py's stage-manifest-commit-rename machinery
+    and verified (deep SHA-256) before its weights are ever served."""
+
+    def __init__(self, root: str, subdir: str = MODELS_SUBDIR):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        self.dir = os.path.join(self.root, subdir)
+        self._lock = threading.Lock()
+
+    def _version_dir(self, name: str, version: str) -> str:
+        _check_token("name", name)
+        _check_token("version", version)
+        return os.path.join(self.dir, name, version)
+
+    # -------------------------------------------------------------- publish
+    def publish(self, name: str, version: str, config, variables,
+                metadata: Optional[Dict[str, Any]] = None,
+                force: bool = False) -> str:
+        """Snapshot ``(config, variables)`` into the store as
+        ``name@version``, atomically (the r20 checkpoint saver: staged
+        tmp dir, per-file SHA-256 MANIFEST, COMMIT seal, os.replace).
+        Returns the version directory.  Raises ``ModelVersionExists``
+        when the version is already complete (immutable) unless
+        ``force=True`` — force exists for re-publishing after a torn
+        write, not for mutating a served version."""
+        from raft_stereo_tpu.training.checkpoint import (is_valid_checkpoint,
+                                                         save_checkpoint)
+
+        path = self._version_dir(name, version)
+        with self._lock:
+            if not force and is_valid_checkpoint(path):
+                raise ModelVersionExists(
+                    f"model {model_coord(name, version)} already exists "
+                    f"in {self.dir} — versions are immutable; publish a "
+                    f"new version (or force=True to repair a torn one)")
+        tree = {"params": variables.get("params", variables)}
+        if isinstance(variables, dict) and variables.get("batch_stats"):
+            tree["batch_stats"] = variables["batch_stats"]
+        meta = dict(metadata or {})
+        meta.setdefault("name", name)
+        meta.setdefault("version", version)
+        save_checkpoint(path, config, tree, runtime_state=meta)
+        log.info("published model %s -> %s",
+                 model_coord(name, version), path)
+        return path
+
+    # ---------------------------------------------------------------- load
+    def load(self, name: str, version: str,
+             deep: bool = True) -> RegisteredModel:
+        """Load one version as a ``RegisteredModel``; ``deep`` (default)
+        verifies every file against the sealed SHA-256 manifest first —
+        a corrupt blob raises typed instead of serving wrong weights."""
+        from raft_stereo_tpu.training.checkpoint import (is_valid_checkpoint,
+                                                         load_runtime_state,
+                                                         load_weights,
+                                                         verify_manifest)
+
+        path = self._version_dir(name, version)
+        if not is_valid_checkpoint(path):
+            raise ModelStoreError(
+                f"model {model_coord(name, version)} is missing or torn "
+                f"under {self.dir}")
+        if deep:
+            ok, reason = verify_manifest(path)
+            if not ok:
+                raise ModelStoreError(
+                    f"model {model_coord(name, version)} failed deep "
+                    f"validation: {reason}")
+        cfg, variables = load_weights(path)
+        return RegisteredModel(name=name, version=version, config=cfg,
+                               variables=variables,
+                               metadata=load_runtime_state(path))
+
+    def resolve(self, spec: str, deep: bool = True) -> RegisteredModel:
+        """Load a ``name@version`` spec; a bare ``name`` resolves to the
+        newest complete version."""
+        name, version = parse_model_spec(spec)
+        if version is None:
+            version = self.latest_version(name)
+            if version is None:
+                raise ModelStoreError(
+                    f"model {name!r} has no complete versions under "
+                    f"{self.dir}")
+        return self.load(name, version, deep=deep)
+
+    # -------------------------------------------------------------- queries
+    def has(self, name: str, version: str) -> bool:
+        from raft_stereo_tpu.training.checkpoint import is_valid_checkpoint
+        try:
+            return is_valid_checkpoint(self._version_dir(name, version))
+        except ValueError:
+            return False
+
+    def versions(self, name: str) -> List[str]:
+        """Complete versions of one model, sorted (publication order is
+        not recoverable from names alone; callers wanting the newest use
+        ``latest_version`` — mtime-ranked)."""
+        from raft_stereo_tpu.training.checkpoint import is_valid_checkpoint
+        root = os.path.join(self.dir, _check_token("name", name))
+        try:
+            entries = sorted(os.listdir(root))
+        except OSError:
+            return []
+        return [e for e in entries
+                if ".tmp-" not in e and ".old-" not in e
+                and is_valid_checkpoint(os.path.join(root, e))]
+
+    def latest_version(self, name: str) -> Optional[str]:
+        root = os.path.join(self.dir, _check_token("name", name))
+        best, best_mtime = None, -1.0
+        for v in self.versions(name):
+            mtime = os.path.getmtime(os.path.join(root, v))
+            if mtime > best_mtime:
+                best, best_mtime = v, mtime
+        return best
+
+    def list_models(self) -> Dict[str, List[str]]:
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return {}
+        out = {}
+        for n in names:
+            if not _TOKEN_RE.match(n):
+                continue
+            vs = self.versions(n)
+            if vs:
+                out[n] = vs
+        return out
+
+    def verify(self, name: str, version: str) -> Tuple[bool, str]:
+        """Deep integrity verdict of one version (``(ok, reason)``) —
+        the operator's pre-rollout check."""
+        from raft_stereo_tpu.training.checkpoint import verify_manifest
+        return verify_manifest(self._version_dir(name, version))

@@ -337,6 +337,13 @@ class StereoSession:
     # keyframe guard, and crash demotion drop both, so a warm-h frame
     # never mixes a fresh disparity with a stale trajectory.
     hidden: Optional[object] = None
+    # Registered-model PIN (round 21 multi-model serving): the model
+    # name this stream's first frame resolved to, or None for the
+    # implicit model.  Every later frame dispatches against the pinned
+    # model — a stream never mixes weights mid-flight — and the pin
+    # rides the handoff meta so an importer that doesn't serve it
+    # degrades typed-cold instead of warm-starting on other weights.
+    model: Optional[str] = None
     frame_index: int = 0          # frames COMPLETED (the next frame's index)
     warm_frames: int = 0
     cold_frames: int = 0
@@ -387,6 +394,10 @@ class StereoSession:
                                                  else None)}
         for name in _RECORD_COUNTERS:
             meta[name] = int(getattr(self, name))
+        if self.model is not None:
+            # Only when pinned: implicit-model records stay byte-
+            # identical to pre-registry blobs (same digest, same meta).
+            meta["model"] = self.model
         return meta, {"flow_low": self.flow_low, "thumb": self.thumb,
                       "ctx": self.ctx, "hidden": self.hidden}
 
@@ -401,6 +412,7 @@ class StereoSession:
                           if meta.get("raw_shape") else None)
         for name in _RECORD_COUNTERS:
             setattr(self, name, int(meta.get(name, 0)))
+        self.model = meta.get("model") or None
         self.flow_low = arrays.get("flow_low")
         self.thumb = arrays.get("thumb")
         self.ctx = arrays.get("ctx")
@@ -416,6 +428,7 @@ class StereoSession:
     def stats(self) -> Dict[str, object]:
         return {
             "session_id": self.session_id,
+            **({"model": self.model} if self.model is not None else {}),
             "frames": self.frame_index,
             "warm_frames": self.warm_frames,
             "cold_frames": self.cold_frames,

@@ -141,6 +141,12 @@ class CompileRecord:
     compile_s: float
     created_unix: float
     device: str = ""
+    # Registered-model coordinate ("name@version") this executable was
+    # compiled for; None for the engine's implicit model and every
+    # non-serving site.  First-class field (not just embedded in the key
+    # string) so /debug/compiles consumers and cost_report.py can group
+    # by it without parsing keys.
+    model: Optional[str] = None
     flops: Optional[float] = None
     bytes_accessed: Optional[float] = None
     transcendentals: Optional[float] = None
@@ -306,16 +312,20 @@ class CompileRegistry:
 
     # ------------------------------------------------------------ recording
     def record(self, key: str, site: str, compile_s: float,
-               compiled=None, device: str = "") -> CompileRecord:
+               compiled=None, device: str = "",
+               model: Optional[str] = None) -> CompileRecord:
         """Record one compiled executable (``compiled`` may be None — e.g.
         a compile observed but not AOT-captured: compile-time-only
-        record)."""
+        record).  ``model`` is the registered-model coordinate
+        (``name@version``) for multi-model serving sites; None
+        everywhere else."""
         fields = (executable_cost(compiled) if compiled is not None
                   else {"degraded": True})
         rec = CompileRecord(
             key=key, site=site, compile_s=compile_s,
             created_unix=time.time(),
             device=device or _local_device_kind(),
+            model=model,
             flops=fields.get("flops"),
             bytes_accessed=fields.get("bytes_accessed"),
             transcendentals=fields.get("transcendentals"),
@@ -338,10 +348,12 @@ class CompileRegistry:
                 "compile", site=site, key=key,
                 compile_s=round(compile_s, 4), flops=rec.flops,
                 bytes_accessed=rec.bytes_accessed, memory=rec.memory,
-                degraded=rec.degraded, device=rec.device)
+                degraded=rec.degraded, device=rec.device,
+                **({"model": model} if model is not None else {}))
         return rec
 
-    def aot_compile(self, jitted, *args, key: str, site: str, **kwargs):
+    def aot_compile(self, jitted, *args, key: str, site: str,
+                    model: Optional[str] = None, **kwargs):
         """``jitted.lower(*args).compile()`` with the compile recorded.
         Returns the compiled executable, or ``jitted`` itself (and a
         degraded record) when the AOT path is unavailable — the caller can
@@ -353,15 +365,18 @@ class CompileRegistry:
             log.warning("AOT compile of %s failed; falling back to plain "
                         "jit dispatch (compile-time-only record)", key,
                         exc_info=True)
-            self.record(key, site, time.perf_counter() - t0, compiled=None)
+            self.record(key, site, time.perf_counter() - t0, compiled=None,
+                        model=model)
             return jitted
-        self.record(key, site, time.perf_counter() - t0, compiled=compiled)
+        self.record(key, site, time.perf_counter() - t0, compiled=compiled,
+                    model=model)
         return compiled
 
-    def instrument(self, jitted, key: str, site: str) -> "_InstrumentedFn":
+    def instrument(self, jitted, key: str, site: str,
+                   model: Optional[str] = None) -> "_InstrumentedFn":
         """Wrap a jitted callable so its compiles run through the AOT path
         and land in this registry.  Same call signature, same results."""
-        return _InstrumentedFn(self, jitted, key, site)
+        return _InstrumentedFn(self, jitted, key, site, model=model)
 
     # -------------------------------------------------------------- queries
     def get(self, key: str) -> Optional[CompileRecord]:
@@ -428,11 +443,12 @@ class _InstrumentedFn:
     """
 
     def __init__(self, registry: CompileRegistry, jitted, key: str,
-                 site: str):
+                 site: str, model: Optional[str] = None):
         self._registry = registry
         self._jitted = jitted
         self.key = key
         self.site = site
+        self.model = model
         self._lock = threading.Lock()
         self._last = None
         self._by_sig: "collections.OrderedDict[Tuple, Any]" = (
@@ -454,7 +470,7 @@ class _InstrumentedFn:
         if exe is None:
             exe = self._registry.aot_compile(self._jitted, *args,
                                              key=self.key, site=self.site,
-                                             **kwargs)
+                                             model=self.model, **kwargs)
             with self._lock:
                 self._by_sig[sig] = exe
                 while len(self._by_sig) > _MAX_VARIANTS:

@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""CI multi-model smoke: registry end to end — publish, serve, hot
+swap under traffic, canary auto-demotion.  Hermetic on CPU.
+
+The round-21 acceptance properties, proven on a REAL ``raft-serve``
+subprocess behind the in-process fleet router:
+
+1. **Versioned publish** — tools/publish_model.py snapshots two
+   checkpoints as ``tiny@v1`` / ``tiny@v2`` into the shared artifact
+   store (SHA-256 manifest, deep-verified); re-publishing an existing
+   version is a typed refusal (versions are immutable).
+2. **Serve both** — a replica boots with ``--models tiny@v1`` next to
+   its implicit model; ``?model=`` / ``X-Model`` select it (echoed
+   ``X-Model`` / ``X-Model-Version`` headers, the per-model counter
+   ``serve_model_requests_total{model=,version=}`` moves, an unknown
+   name answers the typed 404 ``model_unknown``).
+3. **Hot swap under traffic** — ``POST /admin/models`` registers
+   ``tiny@v2`` and flips the default pointer while stateless traffic
+   runs concurrently: ZERO requests drop (every response 200), the
+   answers' ``X-Model-Version`` moves to v2, and ``/readyz`` gates on
+   the new version's warm ladder (the register response reports
+   ``ready`` only once its prewarm completed).
+4. **Canary auto-demotion** — the router splits 10% of default-traffic
+   onto the canary (deterministic body hash) and shadow-mirrors a
+   fraction of baseline requests for EPE comparison; with a forced
+   regression threshold the sustained divergence demotes the canary to
+   0% TYPED (``canary_demoted`` transition, reason recorded), after
+   which no request is split.  Streaming sessions NEVER consult the
+   policy — a session's frames all run one pinned model.
+
+Writes ``bench_record`` JSON to MODEL_OUT (default MODEL_ci.json; CI
+uploads it).  Exit 0 on success, non-zero with a diagnostic on any
+violation.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/model_smoke.py
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+OUT = os.environ.get("MODEL_OUT", os.path.join(_REPO, "MODEL_ci.json"))
+
+HW = (48, 64)
+ITERS = 2
+N_SWAP_TRAFFIC = 40
+N_CANARY_TRAFFIC = 60
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _post(url, data, headers=None, timeout=300):
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _metric(metrics_text: str, name: str) -> float:
+    hits = re.findall(rf"^{name}(?:{{[^}}]*}})?\s+([0-9.eE+-]+)$",
+                      metrics_text, re.M)
+    return sum(float(h) for h in hits)
+
+
+def _npz_pair(seed=3):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, HW + (3,), dtype=np.uint8)
+    right = np.roll(left, -3, axis=1)
+    buf = io.BytesIO()
+    np.savez(buf, left=left, right=right)
+    return buf.getvalue()
+
+
+def build_checkpoints(workdir: str):
+    """Two tiny checkpoints with DIFFERENT weights — the incumbent and
+    the candidate version."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.training import checkpoint as ckpt_mod
+
+    cfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
+                           corr_backend="reg")
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    paths = []
+    for i, seed in enumerate((0, 7)):
+        variables = model.init(jax.random.PRNGKey(seed), dummy, dummy,
+                               iters=1, test_mode=True)
+        state = {"params": variables["params"]}
+        if "batch_stats" in variables:
+            state["batch_stats"] = variables["batch_stats"]
+        path = os.path.join(workdir, f"ckpt{i}")
+        ckpt_mod.save_checkpoint(path, cfg, state)
+        paths.append(path)
+    return paths
+
+
+def publish_leg(ckpts, store: str) -> dict:
+    """Leg 1: publish tiny@v1 / tiny@v2, refuse a re-publish typed."""
+    import publish_model
+
+    for version, ckpt in zip(("v1", "v2"), ckpts):
+        rc = publish_model.main([
+            "--restore_ckpt", ckpt, "--store", store,
+            "--name", "tiny", "--version", version, "--verify"])
+        assert rc == 0, f"publish tiny@{version} failed"
+    rc = publish_model.main([
+        "--restore_ckpt", ckpts[0], "--store", store,
+        "--name", "tiny", "--version", "v1"])
+    assert rc == 1, "re-publishing an existing version must refuse typed"
+
+    from raft_stereo_tpu.serving.models import ModelStore
+    versions = ModelStore(store).versions("tiny")
+    assert versions == ["v1", "v2"], versions
+    print(f"[model_smoke] published tiny@{{v1,v2}} -> {store}",
+          flush=True)
+    return {"published": versions, "immutability_refused": True}
+
+
+class ReplicaProc:
+    """One raft-serve subprocess serving the implicit model + tiny@v1."""
+
+    def __init__(self, ckpt: str, store: str, workdir: str):
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.log_path = os.path.join(workdir, "replica.log")
+        self._log = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "raft_stereo_tpu.cli.serve",
+             "--restore_ckpt", ckpt, "--host", "127.0.0.1",
+             "--port", str(self.port),
+             "--valid_iters", str(ITERS),
+             "--batch_sizes", "1,2", "--max_batch", "2",
+             "--sessions", "--session_ttl_s", "600",
+             "--warmup_shape", f"{HW[0]}x{HW[1]}",
+             "--executable_cache_dir", store,
+             "--models", "tiny@v1"],
+            cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=self._log, stderr=self._log)
+
+    def wait_ready(self, timeout=420.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica exited rc={self.proc.returncode} before "
+                    f"ready; log tail:\n{self.log_tail()}")
+            try:
+                if _get(f"{self.url}/readyz", timeout=5)[0] == 200:
+                    return
+            except (urllib.error.URLError, urllib.error.HTTPError,
+                    OSError):
+                pass
+            time.sleep(0.25)
+        raise RuntimeError(f"replica never became ready; log tail:\n"
+                           f"{self.log_tail()}")
+
+    def log_tail(self, n=4000):
+        self._log.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read()[-n:].decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    def cleanup(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        self._log.close()
+
+
+def selection_leg(url: str, payload: bytes) -> dict:
+    """Leg 2: ?model= / X-Model selection, typed 404, per-model metric."""
+    status, headers, _ = _post(f"{url}/v1/disparity?model=tiny", payload)
+    assert status == 200, status
+    assert headers.get("X-Model") == "tiny", headers
+    assert headers.get("X-Model-Version") == "v1", headers
+    status, headers, _ = _post(f"{url}/v1/disparity", payload,
+                               headers={"X-Model": "tiny"})
+    assert status == 200 and headers.get("X-Model-Version") == "v1"
+    # the implicit model carries NO model headers (wire-identical)
+    status, headers, _ = _post(f"{url}/v1/disparity", payload)
+    assert status == 200 and "X-Model" not in headers
+    status, _, body = _post(f"{url}/v1/disparity?model=ghost", payload)
+    err = json.loads(body)
+    assert status == 404 and err["error"] == "model_unknown", (status,
+                                                              err)
+    assert err["known"] == ["tiny"], err
+    _, _, m = _get(f"{url}/metrics")
+    per_model = _metric(
+        m.decode(),
+        'serve_model_requests_total{model="tiny",version="v1"}')
+    assert per_model >= 2, per_model
+    models = json.loads(_get(f"{url}/healthz")[2])["models"]
+    assert [r["coord"] for r in models["registered"]] == ["tiny@v1"]
+    assert models["default"] is None
+    print("[model_smoke] ?model selection + typed 404 + per-model "
+          "metric OK", flush=True)
+    return {"selected_v1": True, "unknown_404_typed": True,
+            "per_model_requests": per_model}
+
+
+def hot_swap_leg(url: str, payload: bytes) -> dict:
+    """Leg 3: register tiny@v2 + default flip under live traffic."""
+    results = []
+    stop = threading.Event()
+
+    def traffic():
+        i = 0
+        while not stop.is_set() or i < N_SWAP_TRAFFIC:
+            i += 1
+            try:
+                status, headers, _ = _post(f"{url}/v1/disparity",
+                                           payload, timeout=120)
+                results.append((status,
+                                headers.get("X-Model-Version")))
+            except (urllib.error.URLError, OSError) as e:
+                results.append((0, repr(e)))
+            if stop.is_set() and i >= N_SWAP_TRAFFIC:
+                break
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    time.sleep(0.5)          # traffic in flight before the swap
+    t0 = time.perf_counter()
+    status, _, body = _post(
+        f"{url}/admin/models",
+        json.dumps({"action": "register", "model": "tiny@v2",
+                    "default": True}).encode())
+    swap_s = time.perf_counter() - t0
+    out = json.loads(body)
+    assert status == 200, (status, out)
+    assert out["registered"] and out["ready"], out
+    stop.set()
+    t.join(timeout=300)
+    dropped = [r for r in results if r[0] != 200]
+    assert not dropped, f"requests dropped across the swap: {dropped}"
+    # the default pointer flipped: unnamed requests now answer v2
+    status, headers, _ = _post(f"{url}/v1/disparity", payload)
+    assert status == 200 and headers.get("X-Model-Version") == "v2", \
+        headers
+    assert _get(f"{url}/readyz")[0] == 200
+    st = json.loads(_get(f"{url}/admin/models")[2])
+    assert st["default"] == "tiny"
+    assert [r["coord"] for r in st["registered"]] == ["tiny@v2"]
+    versions = {v for _, v in results}
+    print(f"[model_smoke] hot swap OK: {len(results)} concurrent "
+          f"requests, 0 dropped, register+prewarm {swap_s:.1f}s, "
+          f"versions seen {sorted(v or 'implicit' for v in versions)}",
+          flush=True)
+    return {"concurrent_requests": len(results), "dropped": 0,
+            "register_s": round(swap_s, 3),
+            "default_after": "tiny@v2"}
+
+
+def canary_leg(replica_url: str, workdir: str) -> dict:
+    """Leg 4: 10% canary + shadow compare -> forced regression demotes
+    to 0% typed; sessions never consult the policy."""
+    from raft_stereo_tpu.serving.fleet import (FleetRouter, RolloutConfig,
+                                               RouterConfig,
+                                               RouterHTTPServer)
+
+    # Baseline = the implicit model (weights A), canary = tiny@v2
+    # (weights B): a real divergence, and the forced threshold makes
+    # ANY divergence a regression verdict.
+    status, _, _ = _post(
+        f"{replica_url}/admin/models",
+        json.dumps({"action": "set_default", "model": None}).encode())
+    assert status == 200
+    router = FleetRouter(
+        {"r0": replica_url},
+        RouterConfig(health_poll_s=0.1, health_timeout_s=2.0,
+                     fail_after=3, request_timeout_s=300.0,
+                     fleet_brownout=False),
+        rollout_cfg=RolloutConfig(window=16, min_samples=3,
+                                  epe_threshold=1e-6,
+                                  error_threshold=0.9,
+                                  demote_after_s=0.2)).start()
+    rserver = RouterHTTPServer(router, port=0).start()
+    base = rserver.url
+    try:
+        deadline = time.monotonic() + 60
+        while (router.fleet_status()["ready"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        status, _, _ = _post(
+            f"{base}/admin/rollout",
+            json.dumps({"action": "set", "model": "tiny@v2",
+                        "fraction": 0.1,
+                        "shadow_fraction": 0.6}).encode())
+        assert status == 200
+
+        # Sessions never split: with the canary armed, a streaming
+        # frame routes un-tagged (no X-Model on its answer).
+        sess_payload = _npz_pair(seed=99)
+        status, headers, _ = _post(f"{base}/v1/stream/canary-sess",
+                                   sess_payload, timeout=120)
+        assert status == 200 and "X-Model" not in headers, headers
+        split_before_sessions = json.loads(
+            _get(f"{base}/admin/rollout")[2])["canary_requests"]
+        assert split_before_sessions == 0
+
+        canary_hits = 0
+        for i in range(N_CANARY_TRAFFIC):
+            payload = _npz_pair(seed=1000 + i)   # distinct hash keys
+            status, headers, _ = _post(f"{base}/v1/disparity", payload,
+                                       timeout=120)
+            assert status == 200, status
+            canary_hits += headers.get("X-Model") == "tiny"
+            if json.loads(_get(f"{base}/admin/rollout")[2])["demoted"]:
+                break
+        deadline = time.monotonic() + 30
+        rollout = json.loads(_get(f"{base}/admin/rollout")[2])
+        while not rollout["demoted"] and time.monotonic() < deadline:
+            time.sleep(0.2)     # shadow mirrors are fire-and-forget
+            rollout = json.loads(_get(f"{base}/admin/rollout")[2])
+        assert rollout["demoted"], rollout
+        assert "shadow_epe" in (rollout["demoted_reason"] or ""), rollout
+        assert rollout["fraction"] == 0.0 and rollout["demotions"] == 1
+        assert rollout["shadow_compares"] >= 3, rollout
+        assert any(t["event"] == "canary_demoted"
+                   for t in rollout["transitions"]), rollout
+
+        # post-demotion: the split is OFF — no request carries the tag
+        frozen = rollout["canary_requests"]
+        for i in range(20):
+            payload = _npz_pair(seed=5000 + i)
+            status, headers, _ = _post(f"{base}/v1/disparity", payload,
+                                       timeout=120)
+            assert status == 200 and headers.get("X-Model") != "tiny"
+        after = json.loads(_get(f"{base}/admin/rollout")[2])
+        assert after["canary_requests"] == frozen
+        print(f"[model_smoke] canary OK: {canary_hits} split of "
+              f"{N_CANARY_TRAFFIC}, {rollout['shadow_compares']} shadow "
+              f"compares, demoted typed: {rollout['demoted_reason']}",
+              flush=True)
+        return {"canary_requests": frozen,
+                "shadow_compares": rollout["shadow_compares"],
+                "demoted": True,
+                "demoted_reason": rollout["demoted_reason"],
+                "sessions_never_split": True,
+                "post_demotion_splits": 0}
+    finally:
+        rserver.shutdown()
+        router.stop()
+
+
+def main() -> int:
+    from _hermetic import force_cpu
+
+    force_cpu(1)
+
+    from raft_stereo_tpu.telemetry.events import bench_record, write_record
+
+    workdir = tempfile.mkdtemp(prefix="raft-model-smoke-")
+    replica = None
+    try:
+        ckpts = build_checkpoints(workdir)
+        store = os.path.join(workdir, "artifact-store")
+        publish_rec = publish_leg(ckpts, store)
+
+        replica = ReplicaProc(ckpts[0], store, workdir)
+        replica.wait_ready()
+        payload = _npz_pair()
+        selection_rec = selection_leg(replica.url, payload)
+        swap_rec = hot_swap_leg(replica.url, payload)
+        canary_rec = canary_leg(replica.url, workdir)
+
+        rec = bench_record({
+            "metric": "model_rollout_smoke",
+            "value": 1.0,
+            "unit": (f"publish/serve/hot-swap/canary legs all green "
+                     f"({HW[0]}x{HW[1]}, iters={ITERS}, CPU)"),
+            "model": {
+                "publish": publish_rec,
+                "selection": selection_rec,
+                "hot_swap": swap_rec,
+                "canary": canary_rec,
+            },
+        })
+        print(json.dumps(rec))
+        write_record(OUT, rec, indent=1)
+        print(f"model smoke OK -> {OUT}", flush=True)
+        return 0
+    except AssertionError as e:
+        print(f"MODEL SMOKE FAILED: {e}", file=sys.stderr, flush=True)
+        if replica is not None:
+            print(replica.log_tail(), file=sys.stderr)
+        return 1
+    finally:
+        if replica is not None:
+            replica.cleanup()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

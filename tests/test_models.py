@@ -1,0 +1,398 @@
+"""Model-registry tests (tier-1, CPU): the round-21 multi-model surface.
+
+Three layers:
+
+* **ModelStore** — versioned publish/load round-trip, immutability,
+  deep SHA-256 validation refusing a tampered blob, spec parsing.
+* **Engine registry** — key NON-COLLISION across the full coordinate
+  space (model, version, tier, family, quant never share a compile-cost
+  or persist key) and the BITWISE single-model pin: an engine with no
+  registered models produces exactly the pre-registry keys, fingerprint,
+  and answers — and registering a non-default model changes none of
+  them.  Plus hot registration (idempotent), default flip, typed
+  retirement, and session pinning (no session ever sees two versions).
+* **RolloutPolicy** — deterministic assignment, hysteresis demotion.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RaftStereoConfig
+from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+from raft_stereo_tpu.serving import ServeConfig, StereoService
+from raft_stereo_tpu.serving.models import (ModelStore, ModelStoreError,
+                                            ModelUnknown,
+                                            ModelVersionExists,
+                                            model_coord, parse_model_spec)
+
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+ITERS = 1
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+@pytest.fixture(scope="module")
+def tiny_model_v2(tiny_model):
+    """Same architecture, different weights — a plausible new version."""
+    cfg, variables = tiny_model
+    v2 = jax.tree_util.tree_map(lambda a: a + 0.01, variables)
+    return cfg, v2
+
+
+def _pair(hw=(48, 64), seed=3):
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, hw + (3,), dtype=np.uint8)
+    return left, np.roll(left, -3, axis=1)
+
+
+# ------------------------------------------------------------- spec parsing
+def test_parse_model_spec_and_coord():
+    assert parse_model_spec("kitti@v2") == ("kitti", "v2")
+    assert parse_model_spec("kitti") == ("kitti", None)
+    assert model_coord("kitti", "v2") == "kitti@v2"
+    for bad in ("", "a/b", "a@", "@v1", "a@b@c", "a b"):
+        with pytest.raises(ValueError):
+            parse_model_spec(bad)
+
+
+# -------------------------------------------------------------- model store
+def test_store_publish_load_roundtrip(tmp_path, tiny_model):
+    cfg, variables = tiny_model
+    store = ModelStore(str(tmp_path))
+    store.publish("tiny", "v1", cfg, variables,
+                  metadata={"note": "first"})
+    assert store.has("tiny", "v1")
+    assert store.versions("tiny") == ["v1"]
+    assert store.list_models() == {"tiny": ["v1"]}
+    reg = store.load("tiny", "v1", deep=True)
+    assert reg.coord == "tiny@v1"
+    assert reg.config == cfg
+    assert reg.metadata["note"] == "first"
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(reg.variables)[0]),
+        np.asarray(jax.tree_util.tree_leaves(variables)[0]))
+    ok, reason = store.verify("tiny", "v1")
+    assert ok, reason
+
+
+def test_store_versions_are_immutable(tmp_path, tiny_model):
+    cfg, variables = tiny_model
+    store = ModelStore(str(tmp_path))
+    store.publish("tiny", "v1", cfg, variables)
+    with pytest.raises(ModelVersionExists):
+        store.publish("tiny", "v1", cfg, variables)
+    store.publish("tiny", "v1", cfg, variables, force=True)  # torn repair
+
+
+def test_store_resolve_latest_and_unknown(tmp_path, tiny_model,
+                                          tiny_model_v2):
+    cfg, v1 = tiny_model
+    _, v2 = tiny_model_v2
+    store = ModelStore(str(tmp_path))
+    store.publish("tiny", "v1", cfg, v1)
+    store.publish("tiny", "v2", cfg, v2)
+    assert store.latest_version("tiny") == "v2"
+    assert store.resolve("tiny").version == "v2"   # bare name = newest
+    assert store.resolve("tiny@v1").version == "v1"
+    with pytest.raises(ModelStoreError):
+        store.resolve("nope")
+
+
+def test_store_deep_validation_refuses_tamper(tmp_path, tiny_model):
+    cfg, variables = tiny_model
+    store = ModelStore(str(tmp_path))
+    path = store.publish("tiny", "v1", cfg, variables)
+    import os
+    victim = max(
+        (os.path.join(d, f) for d, _, fs in os.walk(path) for f in fs
+         if not f.startswith(("MANIFEST", "COMMIT"))),
+        key=os.path.getsize)
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ok, reason = store.verify("tiny", "v1")
+    assert not ok and reason
+    with pytest.raises(ModelStoreError, match="deep validation"):
+        store.load("tiny", "v1", deep=True)
+
+
+# ---------------------------------------------------- engine key identity
+@pytest.fixture()
+def published_store(tmp_path_factory, tiny_model, tiny_model_v2):
+    cfg, v1 = tiny_model
+    _, v2 = tiny_model_v2
+    root = str(tmp_path_factory.mktemp("model_store"))
+    store = ModelStore(root)
+    store.publish("tiny", "v1", cfg, v1)
+    store.publish("tiny", "v2", cfg, v2)
+    return root
+
+
+def test_single_model_engine_is_bitwise_unchanged(tiny_model,
+                                                  published_store):
+    """The acceptance pin: with no registered models, every key and the
+    exec-config fingerprint are exactly the pre-registry build's; and
+    registering a NON-default model changes none of them, including the
+    answer bytes of an implicit-model request."""
+    cfg, variables = tiny_model
+    left, right = _pair()
+    serve = dict(max_batch=2, iters=ITERS)
+    with StereoService(cfg, variables, ServeConfig(**serve)) as plain:
+        cost_ref = plain._cost_key((64, 96), 1)
+        disk_ref = plain._disk_key((64, 96), 1, 0, None)
+        fp_ref = plain.exec_config_fingerprint()
+        flow_ref = plain.infer(left, right, timeout=120).flow
+    with StereoService(cfg, variables, ServeConfig(
+            model_store_dir=published_store, **serve)) as svc:
+        assert svc._cost_key((64, 96), 1) == cost_ref
+        assert svc._disk_key((64, 96), 1, 0, None) == disk_ref
+        assert svc.exec_config_fingerprint() == fp_ref
+        assert ",model=" not in cost_ref
+        res = svc.infer(left, right, timeout=120)
+        assert res.model is None and res.model_version is None
+        assert np.array_equal(res.flow, flow_ref)
+        svc.register_model("tiny@v1", prewarm=False)
+        # Registering (without the default flip) moves NOTHING on the
+        # implicit surface.
+        assert svc._cost_key((64, 96), 1) == cost_ref
+        assert svc._disk_key((64, 96), 1, 0, None) == disk_ref
+        assert svc.exec_config_fingerprint() == fp_ref
+        assert np.array_equal(svc.infer(left, right, timeout=120).flow,
+                              flow_ref)
+        # The default FLIP is what changes the fingerprint (a handoff
+        # exported under another default must re-enter typed-cold).
+        svc.set_default_model("tiny")
+        assert svc.exec_config_fingerprint() != fp_ref
+
+
+def test_keys_never_collide_across_coordinates(tiny_model,
+                                               published_store):
+    """(model, version, tier, family, quant) all separate both the
+    compile-cost key and the persist content key."""
+    from raft_stereo_tpu.serving.engine import FAMILY_STATE, FAMILY_WARM
+
+    cfg, variables = tiny_model
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS,
+            tiers=("interactive", "quality"),
+            model_store_dir=published_store)) as svc:
+        svc.register_model("tiny@v1", prewarm=False)
+        b = (64, 96)
+        cost_keys = [
+            svc._cost_key(b, 1),
+            svc._cost_key(b, 2),
+            svc._cost_key(b, 1, tier="interactive"),
+            svc._cost_key(b, 1, family=FAMILY_STATE),
+            svc._cost_key(b, 1, family=FAMILY_WARM),
+            svc._cost_key(b, 1, model="tiny"),
+            svc._cost_key(b, 1, tier="interactive", model="tiny"),
+            svc._cost_key(b, 1, family=FAMILY_STATE, model="tiny"),
+        ]
+        assert len(set(cost_keys)) == len(cost_keys)
+        assert cost_keys[5].endswith(",model=tiny@v1)")
+        disk_keys = [
+            svc._disk_key(b, 1, 0, None),
+            svc._disk_key(b, 2, 0, None),
+            svc._disk_key(b, 1, 0, "interactive"),
+            svc._disk_key(b, 1, 0, None, family=FAMILY_STATE),
+            svc._disk_key(b, 1, 0, None, model="tiny"),
+            svc._disk_key(b, 1, 0, "interactive", model="tiny"),
+        ]
+        assert len(set(disk_keys)) == len(disk_keys)
+        v1_disk = svc._disk_key(b, 1, 0, None, model="tiny")
+        v1_cost = svc._cost_key(b, 1, model="tiny")
+        # A new VERSION under the same name gets new keys (same config,
+        # same everything — only the version coordinate moved).
+        svc.register_model("tiny@v2", prewarm=False)
+        assert svc._disk_key(b, 1, 0, None, model="tiny") != v1_disk
+        assert svc._cost_key(b, 1, model="tiny") != v1_cost
+
+
+# ----------------------------------------------------- engine registration
+def test_register_default_flip_retire_lifecycle(tiny_model,
+                                                published_store):
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS,
+            model_store_dir=published_store)) as svc:
+        out = svc.register_model("tiny@v1", prewarm=False)
+        assert out["registered"] and out["default"] is None
+        # idempotent re-register
+        assert not svc.register_model("tiny@v1",
+                                      prewarm=False)["registered"]
+        res = svc.infer(left, right, model="tiny", timeout=120)
+        assert (res.model, res.model_version) == ("tiny", "v1")
+        st = svc.models_status()
+        assert st["default"] is None
+        assert [m["coord"] for m in st["registered"]] == ["tiny@v1"]
+        # unknown model: typed, with the known list
+        with pytest.raises(ModelUnknown) as ei:
+            svc.infer(left, right, model="nope", timeout=120)
+        assert ei.value.model == "nope" and ei.value.known == ["tiny"]
+        # the default flip routes unnamed requests to the model
+        svc.set_default_model("tiny")
+        res = svc.infer(left, right, timeout=120)
+        assert (res.model, res.model_version) == ("tiny", "v1")
+        # retiring the default is refused typed (flip first)
+        with pytest.raises(RuntimeError, match="default"):
+            svc.retire_model("tiny")
+        svc.set_default_model(None)
+        assert svc.retire_model("tiny", timeout=10)["retired"]
+        assert svc.models_status()["registered"] == []
+        with pytest.raises(ModelUnknown):
+            svc.infer(left, right, model="tiny", timeout=120)
+        # the implicit model still serves
+        assert svc.infer(left, right, timeout=120).model is None
+
+
+def test_register_version_replace_answers_new_weights(tiny_model,
+                                                      published_store):
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS,
+            model_store_dir=published_store)) as svc:
+        svc.register_model("tiny@v1", prewarm=False)
+        f1 = svc.infer(left, right, model="tiny", timeout=120)
+        svc.register_model("tiny@v2", prewarm=False)   # live replace
+        f2 = svc.infer(left, right, model="tiny", timeout=120)
+        assert f2.model_version == "v2"
+        assert not np.array_equal(f1.flow, f2.flow)
+
+
+def test_boot_time_models_and_default(tiny_model, published_store):
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS, models=("tiny@v1",),
+            default_model="tiny",
+            model_store_dir=published_store)) as svc:
+        res = svc.infer(left, right, timeout=120)
+        assert (res.model, res.model_version) == ("tiny", "v1")
+
+
+def test_serve_config_models_validation(published_store):
+    with pytest.raises(ValueError, match="store"):
+        ServeConfig(models=("tiny@v1",))
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeConfig(models=("tiny@v1", "tiny@v2"),
+                    model_store_dir=published_store)
+    with pytest.raises(ValueError, match="default_model"):
+        ServeConfig(default_model="ghost",
+                    model_store_dir=published_store)
+
+
+# ------------------------------------------------------- session pinning
+def test_session_pins_one_model_version(tiny_model, published_store):
+    cfg, variables = tiny_model
+    left, right = _pair()
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS, sessions=True,
+            model_store_dir=published_store)) as svc:
+        svc.register_model("tiny@v1", prewarm=False)
+        res = svc.infer_session("s1", left, right, model="tiny",
+                                timeout=120)
+        assert res.model == "tiny"
+        # later frames inherit the pin without naming it
+        assert svc.infer_session("s1", left, right,
+                                 timeout=120).model == "tiny"
+        # a session never spans two models: mid-stream switch is typed
+        with pytest.raises(ValueError, match="pinned"):
+            svc.infer_session("s1", left, right, model="other",
+                              timeout=120)
+        sess = svc.sessions.get("s1")
+        assert sess is not None and sess.model == "tiny"
+        assert "model" in sess.to_record()[0]
+        # an implicit-model session's record carries NO model key —
+        # its wire bytes are the pre-registry format
+        svc.infer_session("s2", left, right, timeout=120)
+        assert "model" not in svc.sessions.get("s2").to_record()[0]
+
+
+# ------------------------------------------------------------ rollout policy
+def _mk_policy(**cfg_kw):
+    from raft_stereo_tpu.serving.fleet.rollout import (RolloutConfig,
+                                                       RolloutPolicy)
+    clock = {"t": 0.0}
+    policy = RolloutPolicy(RolloutConfig(**cfg_kw),
+                           clock=lambda: clock["t"])
+    return policy, clock
+
+
+def test_rollout_assignment_is_deterministic():
+    policy, _ = _mk_policy()
+    policy.set_canary("tiny@v2", 0.3, shadow_fraction=0.2)
+    bodies = [f"req-{i}".encode() for i in range(400)]
+    first = [policy.assign(b) for b in bodies]
+    assert first == [policy.assign(b) for b in bodies]   # pure per body
+    frac = sum(1 for a in first if a) / len(first)
+    assert 0.15 < frac < 0.45     # ~0.3, hash-uniform
+    # shadow sampling is independent of (and only on) the baseline arm
+    baseline = [b for b, a in zip(bodies, first) if a is None]
+    shadows = [policy.wants_shadow(b) for b in baseline]
+    assert shadows == [policy.wants_shadow(b) for b in baseline]
+    assert 0 < sum(shadows) < len(shadows)
+
+
+def test_rollout_requires_explicit_version():
+    policy, _ = _mk_policy()
+    with pytest.raises(ValueError, match="version"):
+        policy.set_canary("tiny", 0.1)
+    with pytest.raises(ValueError):
+        policy.set_canary("tiny@v2", 1.5)
+
+
+def test_rollout_demotion_needs_sustained_regression():
+    policy, clock = _mk_policy(min_samples=4, error_threshold=0.5,
+                               demote_after_s=2.0)
+    policy.set_canary("tiny@v2", 0.5)
+    for _ in range(4):
+        policy.note_canary_result(False)
+    assert not policy.status()["demoted"]      # verdict, but no dwell yet
+    assert policy.assign(b"x") in (None, "tiny")
+    clock["t"] = 3.0
+    assert policy.poll()                       # dwell elapsed -> demoted
+    st = policy.status()
+    assert st["demoted"] and "error rate" in st["demoted_reason"]
+    assert st["fraction"] == 0.0
+    assert all(policy.assign(f"y{i}".encode()) is None for i in range(50))
+    assert not policy.poll()                   # one-way: fires once
+
+
+def test_rollout_recovery_resets_dwell():
+    policy, clock = _mk_policy(min_samples=4, epe_threshold=1.0,
+                               demote_after_s=2.0, window=8)
+    policy.set_canary("tiny@v2", 0.5)
+    for _ in range(4):
+        policy.note_shadow_epe(5.0)            # regressing
+    clock["t"] = 1.0
+    for _ in range(8):
+        policy.note_shadow_epe(0.01)           # bad samples age out
+    clock["t"] = 10.0
+    assert not policy.poll() and not policy.status()["demoted"]
+    # re-arming after a demotion clears the evidence + demoted latch
+    for _ in range(12):
+        policy.note_shadow_epe(9.0)
+    clock["t"] = 20.0
+    policy.poll()
+    assert policy.status()["demoted"]
+    policy.set_canary("tiny@v3", 0.1)
+    st = policy.status()
+    assert not st["demoted"] and st["model"] == "tiny@v3"

@@ -120,7 +120,11 @@ def test_loader_quarantines_raising_sample_and_substitutes(tmp_path):
     assert vals == [[0.0, 1.0], [2.0, 4.0], [4.0, 5.0], [6.0, 7.0]]
     assert loader.stats["quarantined"] == 1 and loader.quarantined == {3}
     with open(qp) as f:
-        assert json.load(f)["indices"] == [3]
+        payload = json.load(f)
+    # round 21: content-hash keyed format (key None here — the test
+    # dataset exposes no sample_paths, so index identity is the fallback)
+    assert payload["version"] == 2
+    assert [e["index"] for e in payload["samples"]] == [3]
     # a fresh loader starts from the persisted quarantine list
     loader2 = StereoLoader(_FaultDataset(bad=(3,)), batch_size=2,
                            num_workers=0, shuffle=False, epochs=1,
@@ -128,6 +132,64 @@ def test_loader_quarantines_raising_sample_and_substitutes(tmp_path):
     assert loader2.quarantined == {3}
     assert _values(loader2) == vals
     assert loader2.stats["quarantined"] == 0   # no NEW quarantine
+
+
+def test_loader_quarantine_legacy_index_file_migrates(tmp_path):
+    qp = str(tmp_path / "q.json")
+    with open(qp, "w") as f:
+        json.dump({"indices": [3]}, f)        # pre-round-21 format
+    loader = StereoLoader(_FaultDataset(bad=(3,)), batch_size=2,
+                          num_workers=0, shuffle=False, epochs=1,
+                          quarantine_path=qp)
+    assert loader.quarantined == {3}
+    with open(qp) as f:                       # rewritten as v2 in place
+        payload = json.load(f)
+    assert payload["version"] == 2
+    assert [e["index"] for e in payload["samples"]] == [3]
+
+
+def test_loader_quarantine_content_key_survives_relisting(tmp_path):
+    from raft_stereo_tpu.data.loader import sample_content_key
+
+    class _FileDataset(_FaultDataset):
+        """_FaultDataset with real file identity (sample_paths)."""
+
+        def __init__(self, files, **kw):
+            super().__init__(n=len(files), **kw)
+            self.files = list(files)
+
+        def sample_paths(self, i):
+            return (self.files[i],)
+
+    files = []
+    for i in range(8):
+        p = tmp_path / f"s{i}.bin"
+        p.write_bytes(bytes([i]) * (i + 1))
+        files.append(str(p))
+    qp = str(tmp_path / "q.json")
+    ds = _FileDataset(files, bad=(3,))
+    loader = StereoLoader(ds, batch_size=2, num_workers=0, shuffle=False,
+                          epochs=1, quarantine_path=qp)
+    list(loader)
+    assert loader.quarantined == {3}
+    key3 = sample_content_key(ds, 3)
+    with open(qp) as f:
+        assert json.load(f)["samples"] == [{"index": 3, "key": key3}]
+    # Re-list the dataset with a new file prepended: every index shifts
+    # by one, but the content key re-locates the same bad file.
+    extra = tmp_path / "s_new.bin"
+    extra.write_bytes(b"xx" * 9)
+    ds2 = _FileDataset([str(extra)] + files, bad=(4,))
+    loader2 = StereoLoader(ds2, batch_size=2, num_workers=0,
+                           shuffle=False, epochs=1, quarantine_path=qp)
+    assert loader2.quarantined == {4}         # same file, new index
+    # Replacing the bad file (different size) clears its quarantine.
+    with open(files[3], "ab") as f:
+        f.write(b"repaired")
+    loader3 = StereoLoader(_FileDataset(files, bad=()), batch_size=2,
+                           num_workers=0, shuffle=False, epochs=1,
+                           quarantine_path=qp)
+    assert loader3.quarantined == set()
 
 
 def test_loader_retry_succeeds_without_quarantine():

@@ -94,6 +94,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--xl_threshold_pixels", type=int, default=2_000_000)
     p.add_argument("--xl_batch_sizes", default="1")
     p.add_argument("--quant_scales", default=None)
+    p.add_argument("--models", default=None,
+                   help="also build the executable ladders of these "
+                        "registered models (comma-separated "
+                        "name[@version] specs, loaded from the store "
+                        "at --out or --model_store_dir) — replicas "
+                        "booting with --models then fetch those "
+                        "ladders warm too")
+    p.add_argument("--model_store_dir", default=None,
+                   help="model store root when it differs from --out")
     p.add_argument("--max_bytes", type=int, default=None,
                    help="GC bound applied to the store after the build")
     p.add_argument("--manifest", default=None,
@@ -132,6 +141,9 @@ def run(args) -> int:
         executable_cache_dir=args.out,
         executable_cache_max_bytes=args.max_bytes,
         warmup_shapes=tuple(args.shape),
+        models=tuple(m.strip() for m in (args.models or "").split(",")
+                     if m.strip()),
+        model_store_dir=args.model_store_dir,
         prewarm_on_init=False)
     t0 = time.perf_counter()
     svc = StereoService(cfg, variables, serve_cfg)
@@ -155,6 +167,8 @@ def run(args) -> int:
             "families": [f or "base" for f in svc._families()],
             "xl": svc.xl_status(),
             "xl_requested": args.xl_mesh,
+            "models": sorted(m for m in svc._registered_names()
+                             if m is not None),
             "sessions": bool(args.sessions),
             "iters": args.valid_iters,
             "artifacts_built": built,

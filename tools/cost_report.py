@@ -92,7 +92,58 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output path; default COST_REPORT_<tag>.json")
     p.add_argument("--device_peak_tflops", type=float, default=None)
     p.add_argument("--device_peak_gbps", type=float, default=None)
+    p.add_argument("--compiles_json", default=None,
+                   help="instead of compiling anything: read a saved "
+                        "GET /debug/compiles payload and group its "
+                        "executable inventory by the first-class "
+                        "'model' field (multi-model serving, round 21) "
+                        "— per-model executable count, compile "
+                        "seconds, flops.  The implicit model groups "
+                        "under '(implicit)'")
     return p
+
+
+def compiles_by_model(payload: Dict) -> Dict[str, Dict]:
+    """Group a /debug/compiles payload's executables by their ``model``
+    coordinate (None -> "(implicit)"): the per-model compile-cost view
+    an operator reads before/after a hot swap."""
+    groups: Dict[str, Dict] = {}
+    for rec in payload.get("executables") or ():
+        coord = rec.get("model") or "(implicit)"
+        g = groups.setdefault(coord, {
+            "executables": 0, "compile_s": 0.0, "flops": 0.0,
+            "degraded": 0, "sites": {}})
+        g["executables"] += 1
+        g["compile_s"] += float(rec.get("compile_s") or 0.0)
+        g["flops"] += float(rec.get("flops") or 0.0)
+        g["degraded"] += 1 if rec.get("degraded") else 0
+        site = str(rec.get("site") or "unknown")
+        g["sites"][site] = g["sites"].get(site, 0) + 1
+    for g in groups.values():
+        g["compile_s"] = round(g["compile_s"], 4)
+    return groups
+
+
+def run_compiles_report(args) -> int:
+    from raft_stereo_tpu.telemetry.events import write_record
+
+    with open(args.compiles_json) as f:
+        payload = json.load(f)
+    groups = compiles_by_model(payload)
+    rec = {
+        "metric": "compiles_by_model",
+        "source": os.path.abspath(args.compiles_json),
+        "models": groups,
+        "total_executables": payload.get("count"),
+        "total_compile_s": payload.get("total_compile_s"),
+    }
+    out = args.out or f"COMPILES_BY_MODEL_{args.tag}.json"
+    write_record(out, rec, indent=2)
+    print(json.dumps({
+        "metric": "compiles_by_model", "out": out,
+        "models": {k: g["executables"] for k, g in groups.items()},
+    }))
+    return 0
 
 
 def model_config(name: str):
@@ -108,6 +159,8 @@ def model_config(name: str):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.compiles_json:
+        return run_compiles_report(args)
 
     import jax
     import jax.numpy as jnp
