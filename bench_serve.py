@@ -21,9 +21,11 @@ regression: engine throughput must beat the old best, and requests-per-
 dispatch at occupancy >= 2 must beat chain mode's serial 1-per-dispatch
 (acceptance: dispatch count < completed request count).
 
-Prints one JSON line (bench.py contract) and writes BENCH_SERVE_r15.json
-(round 15: the tier sweep gains the int8 "turbo" row plus the
-occupancy-2 turbo-vs-balanced regression pin).
+Prints one JSON line (bench.py contract) and writes BENCH_SERVE_r22.json
+(round 22: the turbo tier now runs the quantized-compute-v2 path —
+quant="int8_mxu", int8 MXU matmuls in the extractor — so the pinned
+occupancy-2 turbo-vs-balanced stage from round 15 re-measures turbo v2
+against the full-precision adaptive tier under the same 1.10x band).
 On a CPU fallback the model/geometry shrink so the bench completes in
 minutes; on an accelerator it runs the realtime config at KITTI resolution.
 """
@@ -40,7 +42,7 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_REPO, "tests"))
 
-OUT = "BENCH_SERVE_r15.json"
+OUT = "BENCH_SERVE_r22.json"
 BASELINE = "BENCH_SERVE_r06.json"
 XL_OUT = "BENCH_XL_r19.json"
 
@@ -140,16 +142,20 @@ def tier_sweep(cfg, variables, hw, iters, rng, requests: int = 6) -> dict:
     weights are seeded init, so the adaptive tiers may run to the cap —
     ``iters_used`` next to each time keeps the row honest (the trained-
     weights accuracy/latency curve lives in EARLY_EXIT_r12.json; the
-    int8 tier's accuracy gate in QUANT_DRIFT_r15.json).  WARNS when an
+    quantized tier's accuracy gate in QUANT_DRIFT_r22.json).  WARNS when an
     adaptive tier's p50 exceeds the quality tier's beyond the noise
     band (early-exit overhead must never cost latency).
 
-    Round 15 adds the TURBO row (the int8 tier) and a pinned
-    occupancy-2 stage: at occupancy >= 2 turbo must not be slower than
-    balanced — the int8 tier exists to be the cheapest rung, so this is
-    the regression pin for the whole point of the quantized path (WARNS
-    otherwise; on CPU the int8 HBM-residency win is advisory, the
-    honest numbers are the TPU rows, pending as in prior rounds)."""
+    Round 15 added the TURBO row (then the int8 weight-compression
+    tier) and a pinned occupancy-2 stage: at occupancy >= 2 turbo must
+    not be slower than balanced — the quantized tier exists to be the
+    cheapest rung, so this is the regression pin for the whole point of
+    the quantized path (WARNS otherwise).  Round 22 upgrades turbo to
+    quant="int8_mxu" (quantized compute v2: int8x int8->int32 extractor
+    matmuls, rescale after accumulation) and re-runs the same pin — on
+    CPU neither the HBM-residency nor the MXU-throughput win exists, so
+    parity-within-noise is the pass; the honest numbers are the TPU
+    rows, pending as in prior rounds."""
     from raft_stereo_tpu.serving import ServeConfig, StereoService
 
     lefts, rights = _pairs(hw, 4, rng)
@@ -222,8 +228,8 @@ def tier_sweep(cfg, variables, hw, iters, rng, requests: int = 6) -> dict:
             occ2[1]["regression_vs_balanced"] = True
             print(f"WARNING: turbo tier {turbo_ms} ms/request > 1.10x "
                   f"balanced {balanced_ms} ms/request at occupancy 2 — "
-                  f"the int8 tier must be the cheapest rung (regression "
-                  f"pin, round 15)", flush=True)
+                  f"the quantized tier must be the cheapest rung "
+                  f"(regression pin, rounds 15/22)", flush=True)
     finally:
         svc.close()
     return {"latency": rows, "occupancy2": occ2}

@@ -185,10 +185,15 @@ class RaftStereoConfig:
     # per-level scales read by the extended Pallas lookup kernels
     # (models/corr.py).  The memory-bound halves of the per-frame cost
     # (COST_REPORT_r10.json roofline) move 1/4 (vs fp32) or 1/2 (vs
-    # bf16) of the bytes.  "off" (default) compiles the EXACT pre-quant
+    # bf16) of the bytes.  "int8_mxu": the compute-path extension
+    # (quant/matmul.py) — encoder convs MULTIPLY int8×int8 and
+    # accumulate int32 on the MXU (activations quantized in-graph with
+    # calibrated static scales, dynamic max-abs fallback), rescaling to
+    # fp32 once per conv AFTER accumulation; the bytes win becomes a
+    # flops win.  "off" (default) compiles the EXACT pre-quant
     # program — bitwise-identical, pinned by tests/test_quant.py.
     # Accuracy is gated by the measured in-distribution drift
-    # (tools/quant_drift.py -> QUANT_DRIFT_r15.json), the BF16_DRIFT
+    # (tools/quant_drift.py -> QUANT_DRIFT_r22.json), the BF16_DRIFT
     # methodology extended down.  Inference-only: the training CLIs
     # never set it, and the quantized corr path runs under
     # stop_gradient.
@@ -203,6 +208,14 @@ class RaftStereoConfig:
     # computed in-graph (shape-generic, no file dependency, one extra
     # reduction per level per forward).
     quant_corr_scales: Optional[Tuple[float, ...]] = None
+    # Store the quantized correlation entries float8_e4m3 instead of
+    # int8 on hardware that has it (kernels/corr_lookup.py
+    # fp8_corr_available — same 1-byte itemsize, a float grid that is
+    # denser near zero).  Capability-gated at trace: where fp8 is
+    # unavailable the pyramid quantizes int8 exactly as before (the
+    # transparent-fallback family contract), so the knob is safe to
+    # leave on in shared configs.
+    quant_corr_fp8: bool = False
 
     def __post_init__(self):
         if self.context_dims is None:
@@ -271,9 +284,10 @@ class RaftStereoConfig:
                 f"corr_w2_shards={self.corr_w2_shards} shards the 'reg' "
                 f"volume and is incompatible with corr_backend='alt' (which "
                 f"builds no volume) — use 'reg' or 'reg_fused'")
-        if self.quant not in ("off", "int8"):
+        if self.quant not in ("off", "int8", "int8_mxu"):
             raise ValueError(
-                f"quant={self.quant!r} not in ('off', 'int8')")
+                f"quant={self.quant!r} not in "
+                f"('off', 'int8', 'int8_mxu')")
         if self.quant != "off":
             for field, why in (
                     ("rows_shards", self.rows_shards > 1),
@@ -390,11 +404,13 @@ class RequestTier:
 # tools/early_exit_report.py -> EARLY_EXIT_r12.json): "interactive" trades
 # ~hundredths of a px of EPE for the biggest latency cut, "balanced"
 # stops once updates are metric-noise, "quality" is the reference
-# fixed-depth program.  "turbo" is the int8 tier: interactive's exit
-# knobs on the post-training int8 path (quantized encoder weights + int8
-# correlation pyramid) — the bottom rung of the brownout cost ladder,
-# gated by the measured drift (tools/quant_drift.py ->
-# QUANT_DRIFT_r15.json).
+# fixed-depth program.  "turbo" is the quantized tier (v2 since r22):
+# interactive's exit knobs on the int8 COMPUTE path ("int8_mxu" —
+# int8×int8→int32 encoder convs + int8 correlation pyramid,
+# quant/matmul.py) — the bottom rung of the brownout cost ladder, gated
+# by the measured drift (tools/quant_drift.py -> QUANT_DRIFT_r22.json).
+# The r15 weights-only path stays addressable as an inline
+# "name:threshold:min:int8" spec.
 REQUEST_TIERS: Dict[str, RequestTier] = {
     "interactive": RequestTier("interactive", exit_threshold_px=0.05,
                                min_iters=2),
@@ -402,7 +418,7 @@ REQUEST_TIERS: Dict[str, RequestTier] = {
                             min_iters=3),
     "quality": RequestTier("quality", exit_threshold_px=0.0, min_iters=1),
     "turbo": RequestTier("turbo", exit_threshold_px=0.05, min_iters=2,
-                         quant="int8"),
+                         quant="int8_mxu"),
 }
 
 
@@ -433,9 +449,9 @@ def parse_tier(spec: Union[str, RequestTier]) -> RequestTier:
         raise ValueError(f"tier spec {spec!r}: expected "
                          f"'name:threshold_px[:min_iters[:quant]]'") from e
     quant = parts[3] if len(parts) == 4 else "off"
-    if quant not in ("off", "int8"):
+    if quant not in ("off", "int8", "int8_mxu"):
         raise ValueError(f"tier spec {spec!r}: quant {quant!r} not in "
-                         f"('off', 'int8')")
+                         f"('off', 'int8', 'int8_mxu')")
     return RequestTier(parts[0], exit_threshold_px=threshold,
                        min_iters=min_iters, quant=quant)
 

@@ -144,11 +144,14 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     With ``model.config.quant == "int8"`` every variant expects the
     QUANTIZED variable tree (quant/core.quantize_variables) and
     dequantizes it in-register at the top of the program — int8 is what
-    uploads and resides; ``quant="off"`` builds the exact pre-quant
-    jaxpr (no dequant ops are traced).
+    uploads and resides; ``quant="int8_mxu"`` passes the int8 packs
+    THROUGH to the traced program so the encoder convs run the
+    int8×int8→int32 compute path (quant/matmul.QuantConv — the
+    variables tree routes, no dequant is traced); ``quant="off"``
+    builds the exact pre-quant jaxpr (no dequant ops are traced).
     """
     adaptive = early_exit_enabled(model.config)
-    quantized = model.config.quant != "off"
+    quantized = model.config.quant == "int8"
 
     def prepare(variables):
         if quantized:
@@ -375,7 +378,8 @@ class InferenceRunner:
                  donate_images: bool = True,
                  exit_threshold_px: Optional[float] = None,
                  exit_min_iters: Optional[int] = None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 quant_act_scales=None):
         """``shape_bucket`` (e.g. 64) pads to a coarser grid than the
         reference's /32, collapsing nearby image shapes into one compiled
         program — fewer Middlebury recompiles at the cost of deviating from
@@ -417,7 +421,13 @@ class InferenceRunner:
         runner on the post-training int8 path — the given fp32
         ``variables`` are quantized HERE at construction
         (quant/core.quantize_variables; checkpoints on disk stay fp32)
-        and every compiled program dequantizes in-register."""
+        and every compiled program dequantizes in-register; "int8_mxu"
+        additionally keeps the packs IN the traced program so encoder
+        convs multiply int8×int8→int32 (quant/matmul.py).
+        ``quant_act_scales`` (int8_mxu only): calibrated per-conv
+        activation scales (quant/calibrate.conv_input_scales) baked
+        into the packs at quantization time; None leaves every conv on
+        the dynamic in-graph max-abs fallback."""
         if shape_bucket is not None and shape_bucket % divis_by:
             raise ValueError(f"shape_bucket={shape_bucket} must be a "
                              f"multiple of the model's /{divis_by} "
@@ -449,8 +459,9 @@ class InferenceRunner:
             from raft_stereo_tpu.quant.core import (quantize_variables,
                                                     tree_is_quantized)
             if not tree_is_quantized(variables):
-                variables = quantize_variables(variables,
-                                               self.effective_config)
+                variables = quantize_variables(
+                    variables, self.effective_config,
+                    act_scales=quant_act_scales)
         # Per-call trip-count accounting (early exit only): the CLIs print
         # it and tools/early_exit_report.py averages it per validator.
         self.last_iters_used: Optional[int] = None

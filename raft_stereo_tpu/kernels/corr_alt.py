@@ -432,19 +432,27 @@ def _launch_fwd_multi_q(f1, f2cat, coords, radius: int, offsets, widths,
 def alt_lookup_fused_q(fmap1_q: jnp.ndarray,
                        fmap2_pyramid_q: List[jnp.ndarray],
                        coords: jnp.ndarray, radius: int,
-                       out_dtype) -> jnp.ndarray:
-    """The no-volume lookup over INT8 feature maps (round-15 turbo
-    tier): each tile's volume slice is computed on the MXU from int8
-    features upcast in-register — the features move 1/4 (vs fp32) or
-    1/2 (vs bf16) of the HBM bytes per iteration.  The RAW integer
-    correlations come back in ``out_dtype``; the caller applies the
-    combined feature scales ``s1 * s2_level`` per level
-    (models/corr.py) — the dot product is bilinear, so the scales
-    factor out exactly.
+                       out_dtype, q_dtype=None) -> jnp.ndarray:
+    """The no-volume lookup over QUANTIZED feature maps (round-15
+    turbo tier; fp8-capable since r22): each tile's volume slice is
+    computed on the MXU from 1-byte features upcast in-register — the
+    features move 1/4 (vs fp32) or 1/2 (vs bf16) of the HBM bytes per
+    iteration.  The RAW quantized-grid correlations come back in
+    ``out_dtype``; the caller applies the combined feature scales
+    ``s1 * s2_level`` per level (models/corr.py) — the dot product is
+    bilinear, so the scales factor out exactly.
+
+    ``q_dtype`` is the shared grid coordinate (``int8`` default /
+    ``float8_e4m3`` behind ``fp8_corr_available()``) — validated by the
+    same ``check_q_dtype`` contract as ``lookup_pyramid_fused_q``; the
+    kernel body is dtype-generic.
 
     Forward-only (inference tier, under ``stop_gradient``); same
     launch selection and scoped-VMEM gating as ``alt_lookup_fused``
-    with the int8 itemsize shrinking the estimate."""
+    with the 1-byte itemsize shrinking the estimate."""
+    from raft_stereo_tpu.kernels.corr_lookup import check_q_dtype
+
+    check_q_dtype([fmap1_q] + list(fmap2_pyramid_q), q_dtype)
     d = fmap1_q.shape[-1]
     b, h, w1, _ = fmap1_q.shape
     w2s = [f2.shape[2] for f2 in fmap2_pyramid_q]

@@ -83,6 +83,65 @@ def interpret_enabled() -> bool:
 _interpret = interpret_enabled  # internal alias
 
 
+# ------------------------------------------------- fp8 q-entry capability
+# float8_e4m3 correlation entries: same itemsize as int8 (the VMEM-fit
+# estimators below are already itemsize-parameterized, so every budget
+# holds unchanged), but a FLOAT grid — denser near zero where the
+# post-softargmax correlation mass lives.  Availability is a separate
+# capability from the fused kernels themselves: the dtype must exist in
+# this jax build AND the backend must execute it (interpret mode counts
+# — CPU parity tests run the same kernel body through the interpreter).
+# The grid is OCP E4M3 (``float8_e4m3fn``: finite-only, max 448 — the
+# variant TPU/GPU fp8 units implement), not the IEEE ``float8_e4m3``
+# whose 240 finite max would overflow the 448-referenced scales.
+FP8_CORR_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_corr_available() -> bool:
+    """Whether fp8 correlation q-entries can run here: gate BEFORE
+    building an fp8 pyramid (models/corr.corr_q_dtype falls back to
+    int8 when this is False — same transparent-fallback contract as
+    fused_lookup_available)."""
+    if FP8_CORR_DTYPE is None:  # pragma: no cover - all jax>=0.4.31
+        return False
+    if _interpret_override:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _q_dtypes_supported():
+    out = [jnp.dtype(jnp.int8)]
+    if FP8_CORR_DTYPE is not None:
+        out.append(jnp.dtype(FP8_CORR_DTYPE))
+    return tuple(out)
+
+
+def check_q_dtype(pyramid, q_dtype):
+    """Validate one q-entry call's dtype coordinate: every level must
+    carry ``q_dtype`` (None = infer from level 0), and the dtype must be
+    a supported quantized grid.  Returns the resolved ``jnp.dtype``."""
+    q_dtype = jnp.dtype(q_dtype if q_dtype is not None
+                        else pyramid[0].dtype)
+    if q_dtype not in _q_dtypes_supported():
+        raise ValueError(
+            f"q_dtype={q_dtype} not a supported quantized grid "
+            f"{tuple(str(d) for d in _q_dtypes_supported())}")
+    bad = [str(v.dtype) for v in pyramid if jnp.dtype(v.dtype) != q_dtype]
+    if bad:
+        raise ValueError(
+            f"q-entry levels must all be {q_dtype}; got {bad}")
+    if (FP8_CORR_DTYPE is not None
+            and q_dtype == jnp.dtype(FP8_CORR_DTYPE)
+            and not fp8_corr_available()):
+        raise ValueError(
+            "fp8 correlation entries are unavailable on this backend "
+            "(fp8_corr_available() is False) — quantize int8 instead")
+    return q_dtype
+
+
 # -------------------------------------------------- shared hat-sample math
 # The hat-function formulation (module docstring) shared by this kernel and
 # the fused no-volume kernel (kernels/corr_alt.py) — one implementation so
@@ -361,25 +420,32 @@ def lookup_pyramid_fused(pyramid: List[jnp.ndarray], coords: jnp.ndarray,
     return jnp.concatenate(outs, axis=-1)
 
 
-# ------------------------------------------------------ int8 pyramid entry
+# -------------------------------------------------- quantized pyramid entry
 def lookup_pyramid_fused_q(pyramid: List[jnp.ndarray],
                            coords: jnp.ndarray, radius: int,
-                           out_dtype) -> jnp.ndarray:
-    """Fused window lookup over an INT8 pyramid (round-15 turbo tier):
-    the kernels read the int8 volume tiles from HBM — 1/4 (vs fp32) or
-    1/2 (vs bf16) of the bytes the memory-bound lookup moves
-    (COST_REPORT_r10.json roofline) — and the in-kernel fp32 upcast of
-    each tile is the in-register dequant.  The caller applies the
-    per-level scales to the RAW sampled output (models/corr.py): hat
-    sampling is linear, so ``scale * sample(q)`` equals
-    ``sample(scale * q)`` exactly.
+                           out_dtype, q_dtype=None) -> jnp.ndarray:
+    """Fused window lookup over a QUANTIZED pyramid (round-15 turbo
+    tier; fp8-capable since r22): the kernels read the 1-byte volume
+    tiles from HBM — 1/4 (vs fp32) or 1/2 (vs bf16) of the bytes the
+    memory-bound lookup moves (COST_REPORT_r10.json roofline) — and the
+    in-kernel fp32 upcast of each tile is the in-register dequant.  The
+    caller applies the per-level scales to the RAW sampled output
+    (models/corr.py): hat sampling is linear, so ``scale * sample(q)``
+    equals ``sample(scale * q)`` exactly.
 
-    Forward-only by design — the int8 tier is inference-only and runs
-    under ``stop_gradient`` (the fp custom-VJP entries above stay the
-    training path), so no int8 cotangent program exists to get wrong.
-    Same multi-vs-per-level launch selection and VMEM gating as
+    ``q_dtype`` is the grid coordinate: ``int8`` (default, inferred) or
+    ``float8_e4m3`` where ``fp8_corr_available()`` — the kernel body is
+    dtype-generic (the upcast handles either), so the coordinate
+    validates and gates rather than switching code paths; every VMEM
+    fit already keys on the itemsize, identical for both grids.
+
+    Forward-only by design — the quantized tier is inference-only and
+    runs under ``stop_gradient`` (the fp custom-VJP entries above stay
+    the training path), so no quantized cotangent program exists to get
+    wrong.  Same multi-vs-per-level launch selection and VMEM gating as
     ``lookup_pyramid_fused`` (itemsize=1 shrinks the working set, so
     the single-launch path holds to larger shapes)."""
+    check_q_dtype(pyramid, q_dtype)
     b, h, w1, _ = pyramid[0].shape
     w2s = [v.shape[-1] for v in pyramid]
     if (len(pyramid) > 1 and _multi_working_set(

@@ -47,26 +47,55 @@ def corr_quant_enabled(cfg: RaftStereoConfig) -> bool:
     """Whether this config stores the correlation pyramid int8
     (round-15 turbo tier): the lookup is memory-bound
     (COST_REPORT_r10.json roofline), so the int8 volume moves 1/4 (vs
-    fp32) or 1/2 (vs bf16) of the bytes per iteration."""
-    return cfg.quant == "int8" and cfg.quant_corr
+    fp32) or 1/2 (vs bf16) of the bytes per iteration.  The int8_mxu
+    compute mode (r22) shares the identical pyramid path — the modes
+    differ in the ENCODER convs, not here."""
+    return cfg.quant in ("int8", "int8_mxu") and cfg.quant_corr
+
+
+def corr_q_dtype(cfg: RaftStereoConfig):
+    """The quantized correlation grid this trace uses: ``float8_e4m3``
+    when the config asks for it AND the backend can run it
+    (``fp8_corr_available`` — TPU or kernel-interpret mode), else
+    ``int8``.  The capability fallback is transparent by design: a
+    config with ``quant_corr_fp8=True`` compiles everywhere."""
+    from raft_stereo_tpu.kernels.corr_lookup import (FP8_CORR_DTYPE,
+                                                     fp8_corr_available)
+
+    if cfg.quant_corr_fp8 and fp8_corr_available():
+        return FP8_CORR_DTYPE
+    return jnp.int8
 
 
 def quantize_pyramid(pyramid: List[jnp.ndarray], cfg: RaftStereoConfig
                      ) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
-    """Per-level symmetric int8 quantization of the (fp) pyramid:
-    ``(int8 levels, per-level fp32 scales)``.  Scales are the calibrated
+    """Per-level symmetric quantization of the (fp) pyramid:
+    ``(quantized levels, per-level fp32 scales)`` on the
+    ``corr_q_dtype(cfg)`` grid.  Scales are the calibrated
     percentile-clipped constants when the config carries them
-    (``quant_corr_scales``, quant/calibrate.py) or per-level max-abs
+    (``quant_corr_scales``, quant/calibrate.py — int8-referenced, so
+    the fp8 grid rescales them by 127/448) or per-level max-abs
     reductions computed in-graph otherwise.  Inference-only: the volume
-    is detached first (the int8 tier never trains — round() has no
+    is detached first (the quantized tier never trains — round() has no
     useful gradient and the fused q kernels are forward-only)."""
-    from raft_stereo_tpu.quant.core import dynamic_scale, quantize_symmetric
+    from raft_stereo_tpu.quant.core import (FP8_QMAX, dynamic_scale,
+                                            quantize_fp8,
+                                            quantize_symmetric)
 
+    q_dtype = corr_q_dtype(cfg)
+    fp8 = jnp.dtype(q_dtype) != jnp.dtype(jnp.int8)
+    qmax = FP8_QMAX if fp8 else 127.0
     pyramid = [lax.stop_gradient(v) for v in pyramid]
     if cfg.quant_corr_scales is not None:
-        scales = [jnp.float32(s) for s in cfg.quant_corr_scales]
+        # Calibrated scales are absmax/127 by convention (clipped_scale);
+        # a wider grid reuses the same calibrated absmax.
+        scales = [jnp.float32(s * (127.0 / qmax))
+                  for s in cfg.quant_corr_scales]
     else:
-        scales = [dynamic_scale(v) for v in pyramid]
+        scales = [dynamic_scale(v, qmax=qmax) for v in pyramid]
+    if fp8:
+        return ([quantize_fp8(v, s, q_dtype)
+                 for v, s in zip(pyramid, scales)], scales)
     return ([quantize_symmetric(v, s) for v, s in zip(pyramid, scales)],
             scales)
 
@@ -193,17 +222,26 @@ def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
         # the combined scale s1*s2_level factors out of the bilinear dot
         # exactly.  The fused q kernel upcasts in-register; the XLA
         # fallback dequantizes then runs the reference path.
-        from raft_stereo_tpu.quant.core import (dynamic_scale,
+        from raft_stereo_tpu.quant.core import (FP8_QMAX, dynamic_scale,
+                                                quantize_fp8,
                                                 quantize_symmetric)
 
+        q_dtype = corr_q_dtype(cfg)
+        fp8 = jnp.dtype(q_dtype) != jnp.dtype(jnp.int8)
+        qmax = FP8_QMAX if fp8 else 127.0
+
+        def _q(x, s):
+            return (quantize_fp8(x, s, q_dtype) if fp8
+                    else quantize_symmetric(x, s))
+
         f1_det = lax.stop_gradient(fmap1)
-        s1 = dynamic_scale(f1_det)
-        f1_q = quantize_symmetric(f1_det, s1)
+        s1 = dynamic_scale(f1_det, qmax=qmax)
+        f1_q = _q(f1_det, s1)
         f2_qs, s2s = [], []
         for f2 in fmap2_pyramid:
             f2_det = lax.stop_gradient(f2)
-            s2 = dynamic_scale(f2_det)
-            f2_qs.append(quantize_symmetric(f2_det, s2))
+            s2 = dynamic_scale(f2_det, qmax=qmax)
+            f2_qs.append(_q(f2_det, s2))
             s2s.append(s2)
         if use_fused:
             from raft_stereo_tpu.kernels.corr_alt import alt_lookup_fused_q
@@ -215,7 +253,8 @@ def make_corr_fn_alt(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
             def corr_fn(coords):
                 raw = alt_lookup_fused_q(f1_q, f2_qs, coords,
                                          cfg.corr_radius,
-                                         out_dtype=jnp.float32)
+                                         out_dtype=jnp.float32,
+                                         q_dtype=q_dtype)
                 return (raw * scale_vec).astype(compute_dtype)
             return corr_fn
         fmap1 = (f1_q.astype(jnp.float32) * s1)
@@ -269,7 +308,8 @@ def make_corr_fn_reg_fused(cfg: RaftStereoConfig, fmap1, fmap2) -> CorrFn:
             def corr_fn(coords):
                 raw = lookup_pyramid_fused_q(pyramid_q, coords,
                                              cfg.corr_radius,
-                                             out_dtype=jnp.float32)
+                                             out_dtype=jnp.float32,
+                                             q_dtype=corr_q_dtype(cfg))
                 return (raw * scale_vec).astype(compute_dtype)
         else:
             pyramid = _dequantize_levels(pyramid_q, scales, compute_dtype)

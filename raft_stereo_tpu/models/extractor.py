@@ -22,17 +22,22 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from raft_stereo_tpu.models.norm import apply_norm, make_norm
+from raft_stereo_tpu.quant.matmul import QuantConv
 
 # torch kaiming_normal_(mode='fan_out', nonlinearity='relu')
 kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 
 def conv(features, kernel, stride=1, *, dtype, name):
+    # QuantConv IS nn.Conv when the kernel arrives fp (same params,
+    # same program); with a {q8, qscale} pack it runs the int8 MXU
+    # path (quant/matmul.py) — the encoder surface is exactly the set
+    # of convs this factory builds.
     k = (kernel, kernel) if isinstance(kernel, int) else kernel
     pad = tuple((s // 2, s // 2) for s in k)
-    return nn.Conv(features, k, strides=(stride, stride), padding=pad,
-                   dtype=dtype, kernel_init=kaiming_out,
-                   bias_init=nn.initializers.zeros, name=name)
+    return QuantConv(features, k, strides=(stride, stride), padding=pad,
+                     dtype=dtype, kernel_init=kaiming_out,
+                     bias_init=nn.initializers.zeros, name=name)
 
 
 class ResidualBlock(nn.Module):

@@ -9,8 +9,10 @@ placement and the drift-gate policy (tools/quant_drift.py)."""
 
 from raft_stereo_tpu.quant.calibrate import (DEFAULT_PERCENTILE,
                                              SCALES_VERSION, calibrate,
-                                             corr_scales, load_scales,
-                                             save_scales)
+                                             conv_input_scales, corr_scales,
+                                             load_scales, save_scales)
+from raft_stereo_tpu.quant.matmul import (QuantConv, int8_matmul_report,
+                                          quantized_conv_apply)
 from raft_stereo_tpu.quant.core import (QUANT_MODES, clipped_scale,
                                         dequantize_array,
                                         dequantize_variables,
@@ -21,9 +23,11 @@ from raft_stereo_tpu.quant.core import (QUANT_MODES, clipped_scale,
                                         quantized_param_bytes,
                                         tree_is_quantized)
 
-__all__ = ["DEFAULT_PERCENTILE", "QUANT_MODES", "SCALES_VERSION",
-           "calibrate", "clipped_scale", "corr_scales",
-           "dequantize_array", "dequantize_variables", "dynamic_scale",
+__all__ = ["DEFAULT_PERCENTILE", "QUANT_MODES", "QuantConv",
+           "SCALES_VERSION", "calibrate", "clipped_scale",
+           "conv_input_scales", "corr_scales", "dequantize_array",
+           "dequantize_variables", "dynamic_scale", "int8_matmul_report",
            "is_quantized_leaf", "load_scales", "quantize_array",
            "quantize_symmetric", "quantize_variables",
-           "quantized_param_bytes", "save_scales", "tree_is_quantized"]
+           "quantized_conv_apply", "quantized_param_bytes", "save_scales",
+           "tree_is_quantized"]

@@ -179,6 +179,36 @@ def _merge_intermediates(acc: Dict[str, List[np.ndarray]], tree,
     acc.setdefault(prefix, []).append(np.asarray(tree, np.float32))
 
 
+def conv_input_scales(record: Dict) -> Dict[str, float]:
+    """The per-conv activation scales of one calibration record, keyed
+    by "/"-joined PARAM-tree module paths (``"fnet/trunk/conv1"``) — the
+    ``act_scales`` argument of ``quant/core.quantize_variables`` for the
+    int8_mxu compute path.
+
+    Sites come from ``QuantConv``'s ``qin`` sow (the conv's INPUT —
+    mostly relu/norm outputs the automatic ``__call__`` capture never
+    sees).  Record keys carry the calibration pass's merge prefix as
+    their first component (``"fnet/fnet/trunk/conv1/qin"``); strip it
+    and the ``/qin`` suffix to recover the module path.  A path seen by
+    more than one pass keeps the widest range (conservative).  Records
+    from builds predating the qin sow simply yield {} — callers fall
+    back to dynamic in-graph scales."""
+    from raft_stereo_tpu.quant.core import clipped_scale
+
+    out: Dict[str, float] = {}
+    absmax: Dict[str, float] = {}
+    for site, entry in record.get("activations", {}).items():
+        parts = site.split("/")
+        if parts[-1] != "qin" or len(parts) < 3:
+            continue
+        path = "/".join(parts[1:-1])
+        v = float(entry["absmax_clipped"])
+        absmax[path] = max(absmax.get(path, 0.0), v)
+    for path, v in absmax.items():
+        out[path] = clipped_scale(v)
+    return out
+
+
 def corr_scales(record: Dict) -> Tuple[float, ...]:
     """The per-level int8 volume scales of one calibration record — what
     ``RaftStereoConfig.quant_corr_scales`` carries into the compiled

@@ -37,13 +37,17 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-QUANT_MODES = ("off", "int8")
+QUANT_MODES = ("off", "int8", "int8_mxu")
 
 # A quantized leaf is the fp32 kernel array replaced by a dict
 # {"q8": int8[HWIO], "qscale": f32[1,1,1,O]} — a plain all-array pytree
 # (jax.device_put / tree_map / jit all handle it; a string marker would
 # not trace).  The key set IS the marker: no flax module in this model
-# names parameters "q8"/"qscale".
+# names parameters "q8"/"qscale".  The "int8_mxu" compute path adds an
+# optional third member, {"ascale": f32[]} — the calibrated static
+# activation scale for the conv's INPUT (quant/calibrate.py
+# conv_input_scales); packs without it fall back to a dynamic in-graph
+# max-abs scale (quant/matmul.py).
 
 # Top-level param modules whose conv kernels quantize (the encoder
 # surface; see module docstring for why the update block is excluded).
@@ -53,11 +57,16 @@ _ENCODER_PREFIXES = ("context_zqr_conv",)
 
 
 _PACK_KEYS = frozenset(("q8", "qscale"))
+_PACK_KEYS_ASCALE = frozenset(("q8", "qscale", "ascale"))
 
 
 def is_quantized_leaf(x: Any) -> bool:
-    """True for the {q8, qscale} pack ``quantize_variables`` produces."""
-    return isinstance(x, dict) and frozenset(x.keys()) == _PACK_KEYS
+    """True for the {q8, qscale[, ascale]} pack ``quantize_variables``
+    produces."""
+    if not isinstance(x, dict):
+        return False
+    keys = frozenset(x.keys())
+    return keys == _PACK_KEYS or keys == _PACK_KEYS_ASCALE
 
 
 def _quantizable_module(name: str) -> bool:
@@ -90,18 +99,28 @@ def dequantize_array(q, scale):
     return q.astype(jnp.float32) * scale
 
 
-def quantize_variables(variables: Dict, config=None) -> Dict:
+def quantize_variables(variables: Dict, config=None,
+                       act_scales: Optional[Dict[str, float]] = None
+                       ) -> Dict:
     """The int8 inference tree: every encoder conv kernel in
     ``variables["params"]`` replaced by its {q, scale} pack; everything
     else (biases, norms, the update block, batch_stats) passes through
     untouched.  Host-side NumPy — runs once per process; the result is
-    what ``eval/runner.make_forward`` programs with ``quant="int8"``
+    what ``eval/runner.make_forward`` programs with ``quant != "off"``
     take as their ``variables`` argument.  ``config`` is accepted for
     signature symmetry/forward evolution and currently unused (the
-    quantized surface is architectural, not knob-dependent)."""
-    del config
+    quantized surface is architectural, not knob-dependent).
 
-    def walk(tree, under_encoder: bool):
+    ``act_scales`` maps "/"-joined module paths (e.g.
+    ``"fnet/trunk/conv1"`` — the keys ``quant/calibrate.py
+    conv_input_scales`` returns) to calibrated int8 scales for the
+    conv's input; matching packs gain an ``ascale`` member so the
+    int8_mxu compute path quantizes activations with static constants
+    instead of in-graph max-abs reductions."""
+    del config
+    act_scales = act_scales or {}
+
+    def walk(tree, under_encoder: bool, prefix: str):
         if not isinstance(tree, dict) or is_quantized_leaf(tree):
             return tree
         out = {}
@@ -110,14 +129,20 @@ def quantize_variables(variables: Dict, config=None) -> Dict:
             if (in_scope and name == "kernel"
                     and getattr(sub, "ndim", 0) == 4):
                 q, scale = quantize_array(np.asarray(sub))
-                out[name] = {"q8": q, "qscale": scale}
+                pack = {"q8": q, "qscale": scale}
+                ascale = act_scales.get(prefix)
+                if ascale is not None:
+                    pack["ascale"] = np.float32(ascale)
+                out[name] = pack
             else:
-                out[name] = walk(sub, in_scope)
+                out[name] = walk(
+                    sub, in_scope,
+                    f"{prefix}/{name}" if prefix else name)
         return out
 
     out = dict(variables)
     if "params" in out:
-        out["params"] = walk(dict(out["params"]), False)
+        out["params"] = walk(dict(out["params"]), False, "")
     return out
 
 
@@ -165,6 +190,8 @@ def quantized_param_bytes(variables: Dict) -> Dict[str, int]:
         if is_quantized_leaf(tree):
             acc["int8"] += int(np.asarray(tree["q8"]).nbytes)
             acc["scales"] += int(np.asarray(tree["qscale"]).nbytes)
+            if "ascale" in tree:
+                acc["scales"] += int(np.asarray(tree["ascale"]).nbytes)
             return
         if isinstance(tree, dict):
             for v in tree.values():
@@ -189,13 +216,30 @@ def quantize_symmetric(x, scale):
     return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
 
 
-def dynamic_scale(x, eps: float = 1e-12):
-    """In-graph per-tensor symmetric scale: ``max|x| / 127`` — the
+def dynamic_scale(x, eps: float = 1e-12, qmax: float = 127.0):
+    """In-graph per-tensor symmetric scale: ``max|x| / qmax`` — the
     fallback when no calibrated scale file is configured.  One reduction
-    per tensor per forward; deterministic for a given input."""
+    per tensor per forward; deterministic for a given input.  ``qmax``
+    is the grid's largest representable magnitude: 127 for int8 (the
+    default), ``FP8_QMAX`` for the float8_e4m3 correlation entries."""
     import jax.numpy as jnp
 
-    return jnp.maximum(jnp.max(jnp.abs(x)), eps) / 127.0
+    return jnp.maximum(jnp.max(jnp.abs(x)), eps) / qmax
+
+
+# float8_e4m3's largest finite magnitude (1.75 · 2^8): the fp8 analogue
+# of int8's 127 for symmetric scale construction.
+FP8_QMAX = 448.0
+
+
+def quantize_fp8(x, scale, dtype):
+    """Traced fp8 quantization of one activation tensor: clip to the
+    finite e4m3 range first (the cast saturates NaN/inf semantics vary
+    by backend — an explicit clip keeps the grid deterministic), then
+    cast.  Dequant is ``q.astype(f32) * scale``, same as int8."""
+    import jax.numpy as jnp
+
+    return jnp.clip(x / scale, -FP8_QMAX, FP8_QMAX).astype(dtype)
 
 
 def clipped_scale(absmax_percentile: float) -> float:

@@ -927,10 +927,19 @@ class ServingEngine:
         # config, so the compiled programs carry the percentile-clipped
         # constants instead of dynamic in-graph reductions.
         self._quant_corr_scales = None
+        # Calibrated per-conv activation scales for int8_mxu tiers
+        # (quant/calibrate.conv_input_scales): baked into the packs the
+        # lazy host quantization builds (_vars_for); None (no scale
+        # file, or a pre-r22 record without qin sites) leaves the
+        # int8_mxu convs on the dynamic in-graph max-abs fallback.
+        self._quant_act_scales = None
         if serve_cfg.quant_scales_path:
-            from raft_stereo_tpu.quant import corr_scales, load_scales
-            self._quant_corr_scales = corr_scales(
-                load_scales(serve_cfg.quant_scales_path))
+            from raft_stereo_tpu.quant import (conv_input_scales,
+                                               corr_scales, load_scales)
+            _scale_record = load_scales(serve_cfg.quant_scales_path)
+            self._quant_corr_scales = corr_scales(_scale_record)
+            self._quant_act_scales = (conv_input_scales(_scale_record)
+                                      or None)
 
         # Latency tiers: one effective config / model per tier (the
         # early-exit + quant knobs swapped into the SAME architecture —
@@ -2408,8 +2417,15 @@ class ServingEngine:
             if dev is None:
                 if bundle.qvars_host is None:
                     from raft_stereo_tpu.quant import quantize_variables
+                    # One int8 tree serves every quant tier of the
+                    # bundle: the calibrated activation scales ride the
+                    # packs as an extra member that the weights-only
+                    # "int8" mode's in-program dequant simply ignores,
+                    # while "int8_mxu" executables read them as their
+                    # static input-quantization constants.
                     bundle.qvars_host = quantize_variables(
-                        bundle.host_variables)
+                        bundle.host_variables,
+                        act_scales=self._quant_act_scales)
                 dev = jax.device_put(bundle.qvars_host,
                                      self.devices[widx])
                 bundle.qvars[widx] = dev

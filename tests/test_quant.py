@@ -115,9 +115,13 @@ def test_turbo_tier_preset_and_ladder():
     from raft_stereo_tpu.serving.resilience import cost_ladder
 
     turbo = REQUEST_TIERS["turbo"]
-    assert turbo.quant == "int8" and turbo.exit_threshold_px > 0
+    # Turbo v2 (r22): the preset rides the int8 COMPUTE path; the r15
+    # weights-only mode stays reachable through inline specs.
+    assert turbo.quant == "int8_mxu" and turbo.exit_threshold_px > 0
     inline = parse_tier("fast8:0.1:2:int8")
     assert inline.quant == "int8" and inline.min_iters == 2
+    inline_mxu = parse_tier("fast8m:0.1:2:int8_mxu")
+    assert inline_mxu.quant == "int8_mxu" and inline_mxu.min_iters == 2
     with pytest.raises(ValueError, match="quant"):
         parse_tier("bad:0.1:2:fp8")
     tiers = [parse_tier(t) for t in
@@ -445,3 +449,177 @@ def test_ctx_cache_http_header(tiny_model):
     finally:
         server.shutdown()
         svc.close()
+
+
+# ------------------------------------------------ quantized compute (r22)
+def test_ascale_pack_is_quantized_leaf():
+    """Pack detection accepts both key sets: {q8, qscale} (r15) and
+    {q8, qscale, ascale} (r22 calibrated activation scales) — and
+    rejects partial dicts, so a corrupt tree can never half-route."""
+    from raft_stereo_tpu.quant import is_quantized_leaf
+
+    q8 = np.zeros((3, 3, 4, 8), np.int8)
+    qs = np.ones((1, 1, 1, 8), np.float32)
+    assert is_quantized_leaf({"q8": q8, "qscale": qs})
+    assert is_quantized_leaf({"q8": q8, "qscale": qs,
+                              "ascale": np.float32(0.1)})
+    assert not is_quantized_leaf({"q8": q8})
+    assert not is_quantized_leaf({"q8": q8, "qscale": qs, "extra": 1})
+    assert not is_quantized_leaf(np.zeros((3, 3, 4, 8), np.float32))
+
+
+def test_quantconv_pack_matches_fp(tiny_model):
+    """QuantConv routing is data-driven: the same module applied with
+    the fp tree and with a {q8, qscale} pack tree agree within the
+    int8 quantization budget, and the pack apply is finite."""
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(cfg)
+    im = jnp.asarray(_pair()[0][None].astype(np.float32))
+    qvars = quantize_variables(variables)
+    f_fp = np.asarray(model.apply(variables, im, im, iters=2,
+                                  test_mode=True)[1])
+    f_q = np.asarray(model.apply(qvars, im, im, iters=2,
+                                 test_mode=True)[1])
+    assert np.isfinite(f_q).all() and f_q.shape == f_fp.shape
+    # loose on random init — the trained-weights gate is quant_drift's
+    denom = max(np.abs(f_fp).mean(), 1.0)
+    assert np.abs(f_q - f_fp).mean() / denom < 0.5
+
+
+def test_int8_mxu_jaxpr_pin(tiny_model):
+    """The r22 acceptance pin: quant='int8_mxu' traces >= 1 int8 x int8
+    -> int32 conv with NO fp32 dequant feeding any matmul (quantized
+    compute, not dequantize-then-fp32), in both the fixed-depth scan
+    and the early-exit while program.  quant='off' keeps its zero-
+    int8-matmul twin of the existing bitwise pin."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.quant import int8_matmul_report
+
+    cfg, variables = tiny_model
+    img = jnp.zeros((1, 32, 64, 3), jnp.uint8)
+    qvars = quantize_variables(variables)
+    for exit_px in (0.0, 0.05):
+        base = dataclasses.replace(cfg, exit_threshold_px=exit_px)
+        mxu = dataclasses.replace(base, quant="int8_mxu")
+        fwd = make_forward(RAFTStereo(mxu), 2, donate_images=False)
+        rep = int8_matmul_report(jax.make_jaxpr(fwd)(qvars, img, img))
+        assert rep["int8_convs"] + rep["int8_dots"] >= 1, rep
+        assert rep["dequant_fed_matmuls"] == 0, rep
+        off = make_forward(RAFTStereo(base), 2, donate_images=False)
+        rep_off = int8_matmul_report(
+            jax.make_jaxpr(off)(variables, img, img))
+        assert rep_off["int8_convs"] + rep_off["int8_dots"] == 0, rep_off
+
+
+def test_conv_input_scales_mapping(tiny_model):
+    """conv_input_scales maps the calibration record's sown ``qin``
+    sites back to PARAM-TREE paths (the act_scales contract of
+    quantize_variables), and the mapped scales ride the packs as
+    ``ascale`` — absent exactly where calibration has no coverage."""
+    from raft_stereo_tpu.quant import conv_input_scales
+
+    cfg, variables = tiny_model
+    rec = calibrate(cfg, variables, [_pair(), _pair(seed=7)])
+    scales = conv_input_scales(rec)
+    assert scales and all(s > 0 for s in scales.values())
+    params = variables["params"]
+    for path in scales:
+        node = params
+        for part in path.split("/"):
+            assert part in node, f"unresolvable scale path {path!r}"
+            node = node[part]
+        assert "kernel" in node, path
+    assert "fnet/trunk/conv1" in scales
+    # context_zqr convs sit outside the calibration capture surface:
+    # they take the dynamic in-graph fallback, never a stale ascale
+    assert not any(p.startswith("context_zqr") for p in scales)
+    qvars = quantize_variables(variables, act_scales=scales)
+    p = qvars["params"]
+    covered = p["fnet"]["trunk"]["conv1"]["kernel"]
+    uncovered = p["context_zqr_conv0"]["kernel"]
+    assert "ascale" in covered and float(covered["ascale"]) == \
+        pytest.approx(scales["fnet/trunk/conv1"])
+    assert "q8" in uncovered and "ascale" not in uncovered
+    # pre-r22 records (no activations section) degrade to {}
+    assert conv_input_scales({"activations": {}}) == {}
+
+
+def test_fp8_corr_capability_gate():
+    """fp8 q-entries are capability-gated: unavailable on plain CPU
+    (corr_q_dtype transparently falls back to int8 so
+    ``quant_corr_fp8=True`` is safe everywhere), available under the
+    interpret override, and check_q_dtype rejects an fp8 pyramid
+    whenever the gate says no."""
+    import jax.numpy as jnp
+
+    import raft_stereo_tpu.kernels.corr_lookup as cl
+    from raft_stereo_tpu.models.corr import corr_q_dtype
+
+    if cl.FP8_CORR_DTYPE is None:
+        pytest.skip("this jax build has no float8_e4m3fn dtype")
+    cfg = RaftStereoConfig(**TINY, quant="int8", quant_corr_fp8=True)
+    old = cl._interpret_override
+    try:
+        cl._interpret_override = False
+        assert not cl.fp8_corr_available()
+        assert jnp.dtype(corr_q_dtype(cfg)) == jnp.dtype(jnp.int8)
+        fp8_lvl = jnp.zeros((1, 4, 8, 8), cl.FP8_CORR_DTYPE)
+        with pytest.raises(ValueError, match="fp8"):
+            cl.check_q_dtype([fp8_lvl], None)
+        cl._interpret_override = True
+        assert cl.fp8_corr_available()
+        assert jnp.dtype(corr_q_dtype(cfg)) == \
+            jnp.dtype(cl.FP8_CORR_DTYPE)
+        assert cl.check_q_dtype([fp8_lvl], None) == \
+            jnp.dtype(cl.FP8_CORR_DTYPE)
+    finally:
+        cl._interpret_override = old
+    # mixed-dtype pyramids are rejected regardless of capability
+    with pytest.raises(ValueError, match="levels"):
+        cl.check_q_dtype([jnp.zeros((1, 4, 8, 8), jnp.int8),
+                          jnp.zeros((1, 4, 8, 4), jnp.float32)], jnp.int8)
+
+
+def test_fp8_pyramid_lookup_parity_interpret():
+    """Kernel-level fp8 parity in interpret mode: the q entry sampling
+    an fp8 grid equals the fp fused kernel sampling the SAME grid
+    upcast to fp32 — the kernel body is dtype-generic, the in-register
+    upcast is the only difference."""
+    import jax.numpy as jnp
+
+    import raft_stereo_tpu.kernels.corr_lookup as cl
+    from raft_stereo_tpu.quant.core import FP8_QMAX, quantize_fp8
+
+    if cl.FP8_CORR_DTYPE is None:
+        pytest.skip("this jax build has no float8_e4m3fn dtype")
+    rng = np.random.default_rng(2)
+    b, h, w1, radius = 1, 4, 32, 3
+    pyramid_f32 = [
+        jnp.asarray(rng.normal(size=(b, h, w1, w2)).astype(np.float32))
+        for w2 in (32, 16, 8)]
+    coords = jnp.asarray(
+        rng.uniform(0, w1, size=(b, h, w1)).astype(np.float32))
+    old = cl._interpret_override
+    try:
+        cl._interpret_override = True
+        pyramid_q = []
+        for lvl in pyramid_f32:
+            scale = float(np.abs(np.asarray(lvl)).max()) / FP8_QMAX
+            pyramid_q.append(quantize_fp8(lvl, scale, cl.FP8_CORR_DTYPE))
+        got = cl.lookup_pyramid_fused_q(pyramid_q, coords, radius,
+                                        out_dtype=jnp.float32)
+        ref = cl.lookup_pyramid_fused(
+            [q.astype(jnp.float32) for q in pyramid_q], coords, radius)
+        assert jnp.isfinite(got).all()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        cl._interpret_override = old

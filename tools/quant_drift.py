@@ -19,16 +19,23 @@ What runs:
    IDENTICAL weights:
      - ``fp32``       — full-precision reference (reg backend);
      - ``bf16``       — mixed-precision encoders (the r03-r05 subject);
-     - ``int8``       — the turbo tier: int8 encoder weights + int8
+     - ``int8``       — the r15 weights-only-compute tier: int8 encoder
+                        weights (dequantized in-register) + int8
                         correlation pyramid with calibrated scales;
      - ``int8_w``     — weights-only ablation (quant_corr=False): how
-                        much of the drift is weights vs pyramid.
-4. **The gate**: |ΔEPE| of the int8 tier at the d<=96 band must stay
-   within ``--gate_px`` (default 0.05 px — the same budget PRODUCT_r05
-   accepted for the fp16 fetch).  The record carries a ``gate`` object;
+                        much of the drift is weights vs pyramid;
+     - ``int8_mxu``   — the r22 COMPUTE tier (turbo v2): encoder convs
+                        multiply int8×int8→int32 with calibrated static
+                        activation scales (quant/matmul.py) + the same
+                        int8 pyramid — the extra drift over ``int8`` is
+                        exactly the activation quantization.
+4. **The gate**: worst |ΔEPE| of the int8 AND int8_mxu tiers at the
+   d<=96 band must stay within ``--gate_px`` (default 0.05 px — the
+   same budget PRODUCT_r05 accepted for the fp16 fetch).  The record
+   carries a ``gate`` object with a per-mode breakdown;
    scripts/quant_smoke.py asserts it in CI.
 
-Writes QUANT_DRIFT_r15.json (+ the scale file) and prints one JSON line
+Writes QUANT_DRIFT_r22.json (+ the scale file) and prints one JSON line
 per row.  CPU defaults keep it minutes-scale (tiny architecture, two
 bands); on an accelerator pass --full for the KITTI-class geometry.
 """
@@ -49,9 +56,9 @@ sys.path.insert(0, os.path.join(_REPO, "tools"))
 sys.path.insert(0, _REPO)
 
 OUT = os.environ.get("QUANT_DRIFT_OUT",
-                     os.path.join(_REPO, "QUANT_DRIFT_r15.json"))
+                     os.path.join(_REPO, "QUANT_DRIFT_r22.json"))
 SCALES_OUT = os.environ.get("QUANT_SCALES_OUT",
-                            os.path.join(_REPO, "QUANT_SCALES_r15.json"))
+                            os.path.join(_REPO, "QUANT_SCALES_r22.json"))
 
 
 def build_parser():
@@ -212,6 +219,12 @@ def main(argv=None) -> int:
     # --- variants from identical weights --------------------------------
     int8_cfg = dataclasses.replace(cfg, quant="int8",
                                    quant_corr_scales=corr_scales)
+    # The compute tier's variant carries its tree PRE-quantized with the
+    # calibrated activation scales baked into the packs (the runner
+    # skips re-quantization on an already-quantized tree) — the same
+    # tree construction the serving engine's _vars_for performs.
+    act_scales = quant.conv_input_scales(record)
+    mxu_vars = quant.quantize_variables(variables, act_scales=act_scales)
     variants = {
         "fp32": (cfg, variables),
         "bf16": (dataclasses.replace(cfg, mixed_precision=True),
@@ -219,6 +232,8 @@ def main(argv=None) -> int:
         "int8": (int8_cfg, variables),
         "int8_w": (dataclasses.replace(int8_cfg, quant_corr=False),
                    variables),
+        "int8_mxu": (dataclasses.replace(int8_cfg, quant="int8_mxu"),
+                     mxu_vars),
     }
     scenes = make_band_scenes(hw[0], hw[1], bands,
                               n_per_band=args.n_per_band, seed=11)
@@ -231,16 +246,23 @@ def main(argv=None) -> int:
     gate_band = next((b for b in bands if b == "d<=96"),
                      next(iter(bands)))
     gate_rows = [r for r in rows if r["band"] == gate_band]
-    worst = max((abs(r["depe_int8"]) for r in gate_rows), default=None)
+    per_mode = {
+        mode: max((abs(r[f"depe_{mode}"]) for r in gate_rows),
+                  default=None)
+        for mode in ("int8", "int8_mxu")}
+    finite = [v for v in per_mode.values() if v is not None]
+    worst = max(finite) if finite else None
     gate = {"band": gate_band, "budget_px": args.gate_px,
             "worst_abs_depe_px": worst,
+            "per_mode": per_mode,
             "pass": bool(worst is not None and worst <= args.gate_px)}
     if not gate["pass"]:
-        print(f"WARNING: int8 drift gate FAILED: |dEPE|={worst} px > "
-              f"{args.gate_px} px at {gate_band} — do not enable the "
-              f"turbo tier on this checkpoint", flush=True)
+        print(f"WARNING: quant drift gate FAILED: worst |dEPE|={worst} "
+              f"px > {args.gate_px} px at {gate_band} "
+              f"(per mode: {per_mode}) — do not enable the turbo tier "
+              f"on this checkpoint", flush=True)
 
-    qvars = quant.quantize_variables(variables)
+    qvars = mxu_vars
     rec = bench_record({
         "metric": "int8_epe_drift_gate",
         "value": worst,
