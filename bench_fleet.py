@@ -1,0 +1,627 @@
+"""Fleet router load harness (ROADMAP 3d): open-loop session traffic
+up to 10k concurrent streaming sessions against a REAL ``raft-route``
+subprocess.
+
+Two legs:
+
+* **Stub sweep** (the scale leg) — N in-process stub replicas answer the
+  replica protocol with microsecond handlers, so every measured
+  millisecond is the ROUTER: consistent-hash pick, health bookkeeping,
+  forward proxy, response relay.  The sweep steps the concurrent-session
+  count (default 100 → 10 000); each point offers OPEN-LOOP traffic (a
+  pre-drawn Poisson arrival schedule, independent of service progress —
+  a closed loop self-throttles exactly when the router is slow and hides
+  queueing collapse) and records client p50/p99/p99.9, the router
+  process's CPU seconds and peak RSS (/proc), and the router's own
+  ledger/session bookkeeping growth.  The largest point then SIGKILLs
+  one stub mid-traffic and measures the typed-410 wave and lost-ledger
+  growth that failover costs.
+* **Federation overhead** — the same mid-size point twice: background
+  metrics federation effectively OFF (poll interval longer than the
+  run) vs ON at an aggressive 1s cadence, comparing p99 and router CPU.
+  The invariant under test: scraping N replicas must cost the poller,
+  never the request path.
+* **Real-engine leg** — a tiny real ``StereoService`` replica behind the
+  same router subprocess at small N, so the record also carries an
+  end-to-end routed-inference latency with actual model execution.
+
+Prints one JSON line (bench.py contract) and writes BENCH_FLEET_r23.json
+(override with --out; the CI smoke runs a seconds-scale --quick variant
+to BENCH_FLEET_ci.json).
+
+Run from the repo root::
+
+    JAX_PLATFORMS=cpu python bench_fleet.py              # full sweep
+    python bench_fleet.py --sessions 100,1000 --duration_s 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+OUT = "BENCH_FLEET_r23.json"
+_PAGE = os.sysconf("SC_PAGE_SIZE")
+_HZ = os.sysconf("SC_CLK_TCK")
+
+
+# ---------------------------------------------------------------- helpers
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process in seconds (/proc/<pid>/stat)."""
+    with open(f"/proc/{pid}/stat") as f:
+        fields = f.read().rsplit(")", 1)[1].split()
+    return (int(fields[11]) + int(fields[12])) / _HZ
+
+
+def _proc_rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def _metric(text: str, name: str) -> float:
+    import re
+
+    hits = re.findall(rf"^{name}(?:{{[^}}]*}})?\s+([0-9.eE+-]+)$",
+                      text, re.M)
+    return sum(float(h) for h in hits)
+
+
+# ----------------------------------------------------------- stub replica
+class StubReplica:
+    """Protocol-complete, microsecond-cheap replica: the router is the
+    only thing being measured.  Same surface the fleet tests script —
+    healthz/readyz/metrics/spans plus the stream + stateless routes."""
+
+    def __init__(self, name: str):
+        self.name = name
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, ctype="application/json",
+                      extra=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, json.dumps({
+                        "status": "ok", "ready": True, "queue_depth": 0,
+                        "queue_limit": 64, "inflight": 0,
+                        "brownout_level": 0, "xl": None,
+                        "sessions_active": 0}).encode())
+                elif self.path == "/readyz":
+                    self._send(200, b'{"ready": true}')
+                elif self.path.split("?")[0] == "/metrics":
+                    self._send(
+                        200,
+                        (f"# HELP stub_up Stub liveness.\n"
+                         f"# TYPE stub_up gauge\n"
+                         f'stub_up{{stub="{outer.name}"}} 1\n').encode(),
+                        ctype="text/plain; version=0.0.4")
+                else:
+                    self._send(404, b'{"error": "no route"}')
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                path = self.path.split("?")[0]
+                if path.startswith("/v1/stream/"):
+                    sid = path[len("/v1/stream/"):]
+                    self._send(200, b"frame:" + body,
+                               ctype="application/x-npy",
+                               extra=[("X-Session-Id", sid),
+                                      ("X-Warm", "1")])
+                elif path == "/v1/disparity":
+                    self._send(200, b"disp:" + body,
+                               ctype="application/x-npy",
+                               extra=[("X-Batch-Size", "1")])
+                else:
+                    self._send(404, b'{"error": "no route"}')
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        srv.daemon_threads = True
+        srv.request_queue_size = 512
+        self.server = srv
+        self.url = f"http://127.0.0.1:{srv.server_address[1]}"
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class RouterProc:
+    """The measured ``raft-route`` subprocess."""
+
+    def __init__(self, replicas, workdir, federation_poll_s=5.0,
+                 trace_sample_rate=0.0, http_workers=128):
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.log_path = os.path.join(workdir, f"router-{self.port}.log")
+        self._log = open(self.log_path, "wb")
+        argv = [sys.executable, "-m", "raft_stereo_tpu.cli.route",
+                "--host", "127.0.0.1", "--port", str(self.port),
+                "--health_poll_s", "0.5", "--fail_after", "2",
+                "--request_timeout_s", "60", "--no-fleet_brownout",
+                "--federation_poll_s", str(federation_poll_s),
+                "--trace_sample_rate", str(trace_sample_rate),
+                "--http_workers", str(http_workers)]
+        for name, url in replicas.items():
+            argv += ["--replica", f"{name}={url}"]
+        self.proc = subprocess.Popen(
+            argv, cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=self._log, stderr=self._log)
+
+    def wait_ready(self, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"router exited rc={self.proc.returncode}")
+            try:
+                if _get(f"{self.url}/readyz", timeout=5)[0] == 200:
+                    return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.1)
+        raise RuntimeError("router never became ready")
+
+    def cpu_s(self) -> float:
+        return _proc_cpu_s(self.proc.pid)
+
+    def rss_mb(self) -> float:
+        return _proc_rss_mb(self.proc.pid)
+
+    def metrics(self) -> str:
+        return _get(f"{self.url}/metrics", timeout=10)[2].decode()
+
+    def cleanup(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        self._log.close()
+
+
+# ------------------------------------------------------------- load phase
+def open_loop_sessions(router_url: str, n_sessions: int, rate_hz: float,
+                       duration_s: float, workers: int, seed: int = 7):
+    """Offer Poisson traffic at ``rate_hz`` total across ``n_sessions``
+    distinct streaming sessions for ``duration_s``.  The arrival
+    schedule is drawn UP FRONT; workers send each frame at its scheduled
+    offset regardless of how previous frames fared (open loop).  Returns
+    (latencies_s sorted, status counts, offered, achieved_rate)."""
+    rng = np.random.default_rng(seed)
+    n_arrivals = max(1, int(rate_hz * duration_s))
+    offsets = np.cumsum(rng.exponential(1.0 / rate_hz, n_arrivals))
+    offsets = offsets[offsets < duration_s]
+    sids = [f"s{seed}-{i}" for i in range(n_sessions)]
+    # Round-robin assignment keeps every session active through the
+    # window; the ones due first are spread over all replicas.
+    latencies = []
+    statuses = {}
+    lock = threading.Lock()
+    idx = [0]
+    t0 = time.perf_counter()
+
+    def _worker():
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= len(offsets):
+                    return
+                idx[0] += 1
+            due = t0 + offsets[i]
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            sid = sids[i % n_sessions]
+            req = urllib.request.Request(
+                f"{router_url}/v1/stream/{sid}", data=b"frame",
+                method="POST",
+                headers={"Content-Type": "application/x-npz",
+                         "Connection": "close"})
+            t_send = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                code = e.code
+            except (urllib.error.URLError, OSError):
+                code = -1
+            lat = time.perf_counter() - t_send
+            with lock:
+                latencies.append(lat)
+                statuses[code] = statuses.get(code, 0) + 1
+
+    threads = [threading.Thread(target=_worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    wall = time.perf_counter() - t0
+    latencies.sort()
+    return latencies, statuses, len(offsets), len(latencies) / wall
+
+
+def _point_record(name, n_sessions, rate_hz, lat, statuses, offered,
+                  achieved, cpu_d, rss_peak, router_metrics):
+    ok = statuses.get(200, 0)
+    total = sum(statuses.values())
+    return {
+        "leg": name,
+        "sessions": n_sessions,
+        "offered_rate_hz": round(rate_hz, 1),
+        "offered": offered,
+        "answered": total,
+        "ok_200": ok,
+        "statuses": {str(k): v for k, v in sorted(statuses.items())},
+        "achieved_rate_hz": round(achieved, 1),
+        "p50_ms": round(_pct(lat, 0.50) * 1e3, 2) if lat else None,
+        "p99_ms": round(_pct(lat, 0.99) * 1e3, 2) if lat else None,
+        "p999_ms": round(_pct(lat, 0.999) * 1e3, 2) if lat else None,
+        "max_ms": round(lat[-1] * 1e3, 2) if lat else None,
+        "router_cpu_s": round(cpu_d, 2),
+        "router_rss_peak_mb": round(rss_peak, 1),
+        "router_sessions_routed": int(_metric(
+            router_metrics, "fleet_requests_routed_total")),
+        "lost_ledger_size": int(_metric(
+            router_metrics, "fleet_lost_ledger_size")),
+    }
+
+
+def stub_sweep(points, duration_s, session_hz, max_rate, workers,
+               n_replicas, workdir, federation_poll_s=5.0):
+    """The scale leg: one router process, fresh stub fleet per point."""
+    out = []
+    for n_sessions in points:
+        stubs = [StubReplica(f"b{i}") for i in range(n_replicas)]
+        router = RouterProc({s.name: s.url for s in stubs}, workdir,
+                            federation_poll_s=federation_poll_s)
+        try:
+            router.wait_ready()
+            rate = min(max_rate, n_sessions * session_hz)
+            cpu0, rss0 = router.cpu_s(), router.rss_mb()
+            lat, statuses, offered, achieved = open_loop_sessions(
+                router.url, n_sessions, rate, duration_s, workers)
+            cpu1, rss1 = router.cpu_s(), router.rss_mb()
+            rec = _point_record("stub", n_sessions, rate, lat, statuses,
+                                offered, achieved, cpu1 - cpu0,
+                                max(rss0, rss1), router.metrics())
+            out.append(rec)
+            print(f"[bench_fleet] {n_sessions} sessions @ "
+                  f"{rate:.0f}/s: p50 {rec['p50_ms']}ms p99 "
+                  f"{rec['p99_ms']}ms p99.9 {rec['p999_ms']}ms, "
+                  f"router cpu {rec['router_cpu_s']}s rss "
+                  f"{rec['router_rss_peak_mb']}MB", flush=True)
+        finally:
+            router.cleanup()
+            for s in stubs:
+                try:
+                    s.kill()
+                except Exception:
+                    pass
+    return out
+
+
+def failover_leg(n_sessions, duration_s, session_hz, max_rate, workers,
+                 n_replicas, workdir):
+    """Kill one stub mid-traffic at the largest point: measures the
+    typed-410 wave (sticky sessions on the dead member) and the
+    lost-ledger growth the failover writes."""
+    stubs = [StubReplica(f"k{i}") for i in range(n_replicas)]
+    router = RouterProc({s.name: s.url for s in stubs}, workdir)
+    try:
+        router.wait_ready()
+        rate = min(max_rate, n_sessions * session_hz)
+        killer = threading.Timer(duration_s / 3.0, stubs[0].kill)
+        killer.start()
+        cpu0 = router.cpu_s()
+        lat, statuses, offered, achieved = open_loop_sessions(
+            router.url, n_sessions, rate, duration_s, workers, seed=11)
+        killer.cancel()
+        cpu1 = router.cpu_s()
+        metrics = router.metrics()
+        rec = _point_record("failover", n_sessions, rate, lat, statuses,
+                            offered, achieved, cpu1 - cpu0,
+                            router.rss_mb(), metrics)
+        rec["killed_replica"] = stubs[0].name
+        rec["typed_410"] = statuses.get(410, 0)
+        rec["sessions_lost_total"] = int(_metric(
+            metrics, "fleet_sessions_lost_total"))
+        rec["failovers_total"] = int(_metric(
+            metrics, "fleet_failovers_total"))
+        print(f"[bench_fleet] failover @ {n_sessions} sessions: "
+              f"{rec['typed_410']} typed 410s, ledger "
+              f"{rec['lost_ledger_size']}, p99 {rec['p99_ms']}ms",
+              flush=True)
+        return rec
+    finally:
+        router.cleanup()
+        for s in stubs:
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+def federation_overhead_leg(n_sessions, duration_s, session_hz,
+                            max_rate, workers, n_replicas, workdir):
+    """Same load twice: federation poller idle vs aggressive.  The
+    request path must not notice (render is cache-only)."""
+    runs = {}
+    for label, poll_s in (("off", 3600.0), ("on_1s", 1.0)):
+        pts = stub_sweep([n_sessions], duration_s, session_hz, max_rate,
+                         workers, n_replicas, workdir,
+                         federation_poll_s=poll_s)
+        runs[label] = pts[0]
+    off, on = runs["off"], runs["on_1s"]
+    overhead = {
+        "sessions": n_sessions,
+        "off": off, "on_1s": on,
+        "p99_delta_ms": (round(on["p99_ms"] - off["p99_ms"], 2)
+                         if on["p99_ms"] and off["p99_ms"] else None),
+        "cpu_delta_s": round(on["router_cpu_s"] - off["router_cpu_s"],
+                             2),
+    }
+    print(f"[bench_fleet] federation overhead: p99 delta "
+          f"{overhead['p99_delta_ms']}ms, cpu delta "
+          f"{overhead['cpu_delta_s']}s", flush=True)
+    return overhead
+
+
+def real_engine_leg(n_sessions, duration_s, workers, workdir):
+    """Small-N leg with a REAL tiny engine replica behind the router:
+    the record carries an actual routed-inference latency."""
+    import io
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+
+    cfg = RaftStereoConfig(hidden_dims=(32, 32, 32), fnet_dim=64,
+                           corr_backend="reg")
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    rng = np.random.default_rng(3)
+    left = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, left=left, right=np.roll(left, -3, axis=1))
+    payload = buf.getvalue()
+
+    svc = StereoService(cfg, variables,
+                        ServeConfig(max_batch=2, batch_sizes=(1, 2),
+                                    iters=1, sessions=True))
+    server = StereoHTTPServer(svc, port=0).start()
+    router = RouterProc({"real0": server.url}, workdir,
+                        trace_sample_rate=1.0)
+    try:
+        router.wait_ready()
+        # one warmup frame compiles the ladder outside the clock
+        req = urllib.request.Request(
+            f"{router.url}/v1/stream/warmup", data=payload,
+            method="POST",
+            headers={"Content-Type": "application/x-npz"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            resp.read()
+        lock = threading.Lock()
+        latencies, statuses = [], {}
+        traced = [0]
+        deadline = time.monotonic() + duration_s
+        sids = [f"real-{i}" for i in range(n_sessions)]
+
+        def _worker(wid):
+            i = wid
+            while time.monotonic() < deadline:
+                sid = sids[i % n_sessions]
+                i += workers
+                req = urllib.request.Request(
+                    f"{router.url}/v1/stream/{sid}", data=payload,
+                    method="POST",
+                    headers={"Content-Type": "application/x-npz"})
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=120) as resp:
+                        resp.read()
+                        code = resp.status
+                        has_trace = bool(
+                            resp.headers.get("X-Trace-Id"))
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    code, has_trace = e.code, False
+                except (urllib.error.URLError, OSError):
+                    code, has_trace = -1, False
+                lat = time.perf_counter() - t0
+                with lock:
+                    latencies.append(lat)
+                    statuses[code] = statuses.get(code, 0) + 1
+                    traced[0] += 1 if has_trace else 0
+
+        cpu0 = router.cpu_s()
+        threads = [threading.Thread(target=_worker, args=(w,),
+                                    daemon=True)
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s + 300)
+        cpu_d = router.cpu_s() - cpu0
+        latencies.sort()
+        rec = {
+            "leg": "real_engine",
+            "sessions": n_sessions,
+            "answered": sum(statuses.values()),
+            "ok_200": statuses.get(200, 0),
+            "traced_responses": traced[0],
+            "p50_ms": (round(_pct(latencies, 0.50) * 1e3, 2)
+                       if latencies else None),
+            "p99_ms": (round(_pct(latencies, 0.99) * 1e3, 2)
+                       if latencies else None),
+            "router_cpu_s": round(cpu_d, 2),
+        }
+        print(f"[bench_fleet] real engine @ {n_sessions} sessions: "
+              f"{rec['ok_200']}/{rec['answered']} ok, p50 "
+              f"{rec['p50_ms']}ms p99 {rec['p99_ms']}ms, "
+              f"{rec['traced_responses']} traced", flush=True)
+        return rec
+    finally:
+        router.cleanup()
+        server.shutdown()
+        svc.close()
+
+
+# -------------------------------------------------------------------- main
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sessions", default="100,1000,5000,10000",
+                   help="comma list of concurrent-session sweep points")
+    p.add_argument("--duration_s", type=float, default=12.0)
+    p.add_argument("--session_hz", type=float, default=0.5,
+                   help="offered frames/s per session before the "
+                        "--max_rate cap")
+    p.add_argument("--max_rate", type=float, default=1500.0,
+                   help="total offered frames/s cap (the Python client "
+                        "is part of the harness; past this the client "
+                        "is the bottleneck, not the router)")
+    p.add_argument("--workers", type=int, default=192,
+                   help="client sender threads")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--real_sessions", type=int, default=8)
+    p.add_argument("--real_duration_s", type=float, default=8.0)
+    p.add_argument("--skip_real", action="store_true")
+    p.add_argument("--skip_federation", action="store_true")
+    p.add_argument("--skip_failover", action="store_true")
+    p.add_argument("--quick", action="store_true",
+                   help="seconds-scale CI preset (small sweep, short "
+                        "windows)")
+    p.add_argument("--out", default=os.path.join(_REPO, OUT))
+    return p
+
+
+def main(argv=None) -> int:
+    import tempfile
+
+    from raft_stereo_tpu.telemetry.events import (bench_record,
+                                                  write_record)
+
+    args = build_parser().parse_args(argv)
+    if args.quick:
+        args.sessions = "50,200"
+        args.duration_s = min(args.duration_s, 4.0)
+        args.workers = min(args.workers, 48)
+        args.max_rate = min(args.max_rate, 300.0)
+        args.real_duration_s = min(args.real_duration_s, 5.0)
+    points = [int(x) for x in args.sessions.split(",") if x]
+    workdir = tempfile.mkdtemp(prefix="raft-bench-fleet-")
+
+    sweep = stub_sweep(points, args.duration_s, args.session_hz,
+                       args.max_rate, args.workers, args.replicas,
+                       workdir)
+    failover = None
+    if not args.skip_failover:
+        failover = failover_leg(points[-1], args.duration_s,
+                                args.session_hz, args.max_rate,
+                                args.workers, args.replicas, workdir)
+    federation = None
+    if not args.skip_federation:
+        mid = points[min(1, len(points) - 1)]
+        federation = federation_overhead_leg(
+            mid, args.duration_s, args.session_hz, args.max_rate,
+            args.workers, args.replicas, workdir)
+    real = None
+    if not args.skip_real:
+        real = real_engine_leg(args.real_sessions, args.real_duration_s,
+                               min(8, args.workers), workdir)
+
+    top = sweep[-1]
+    rec = bench_record({
+        "metric": "fleet_router_p99_ms_at_max_sessions",
+        "value": top["p99_ms"],
+        "unit": (f"client-observed p99 ms at {top['sessions']} "
+                 f"concurrent sessions, {top['offered_rate_hz']}/s "
+                 f"offered open-loop, {args.replicas} stub replicas, "
+                 f"CPU"),
+        "fleet_load": {
+            "sweep": sweep,
+            "failover": failover,
+            "federation_overhead": federation,
+            "real_engine": real,
+            "config": {
+                "duration_s": args.duration_s,
+                "session_hz": args.session_hz,
+                "max_rate": args.max_rate,
+                "workers": args.workers,
+                "replicas": args.replicas,
+                "quick": args.quick,
+            },
+        },
+    })
+    print(json.dumps(rec))
+    write_record(args.out, rec, indent=1)
+    print(f"bench_fleet OK -> {args.out}")
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,18 @@ mirroring and auto-demotion on sustained regression::
 
     raft-route ... --canary kitti@v2=0.05 --canary_shadow 0.1
 
+Fleet observability (round 23): sample end-to-end traces across the
+router hop, scrape every replica into one federated ``/metrics/fleet``,
+and page on SLO error-budget burn with a coordinated flight-recorder
+dump::
+
+    raft-route ... --trace_sample_rate 0.1 --slo_ms 250 \\
+        --slo_availability 0.999 --flight_recorder_dir /var/log/fleet
+
+    curl -s "http://127.0.0.1:8550/debug/spans?trace=<X-Trace-Id>" \\
+        | python -m json.tool     # merged router + replica timeline
+    curl -s http://127.0.0.1:8550/metrics/fleet | grep replica=
+
 See docs/architecture.md §Fleet / §Multi-model and the README runbooks
 "a replica died", "roll a replica without dropping streams", "the
 router died", "roll out a new checkpoint".
@@ -110,7 +122,16 @@ def build_router(args):
         router_name=args.name,
         standby=args.standby,
         lease_ttl_s=args.lease_ttl_s,
-        peer_url=args.peer)
+        peer_url=args.peer,
+        trace_sample_rate=args.trace_sample_rate,
+        slo_ms=args.slo_ms,
+        slo_availability=args.slo_availability,
+        slo_fast_burn=args.slo_fast_burn,
+        slo_slow_burn=args.slo_slow_burn,
+        federation_poll_s=args.federation_poll_s,
+        federation_timeout_s=args.federation_timeout_s,
+        federation_stale_s=args.federation_stale_s,
+        flight_recorder_dir=args.flight_recorder_dir)
     router = FleetRouter(replicas, cfg)
     canary = parse_canary(args.canary)
     if canary is not None:
@@ -149,7 +170,8 @@ def run_route(args) -> int:
     autoscaler = build_autoscaler(args, router)
     if autoscaler is not None:
         autoscaler.start()
-    server = RouterHTTPServer(router, host=args.host, port=args.port)
+    server = RouterHTTPServer(router, host=args.host, port=args.port,
+                              max_workers=args.http_workers)
     stop = threading.Event()
 
     def _graceful(signum, frame):
@@ -280,6 +302,53 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--autoscale_cooldown_s", type=float, default=5.0)
     p.add_argument("--autoscale_log_dir", default=None,
                    help="directory for launched replicas' logs")
+    # Fleet observability (round 23): cross-process tracing, metrics
+    # federation, SLO burn-rate alerting.
+    p.add_argument("--trace_sample_rate", type=float, default=0.0,
+                   help="fraction of routed requests to trace end to "
+                        "end: the router opens a route.request span "
+                        "tree and propagates a traceparent header so "
+                        "the replica's serve.request becomes a child "
+                        "of the SAME trace id (merged view: GET "
+                        "/debug/spans?trace=<id>).  0 (default) keeps "
+                        "forwarding byte-verbatim")
+    p.add_argument("--slo_ms", type=float, default=None,
+                   help="latency SLO threshold: router-observed "
+                        "end-to-end latencies past this count against "
+                        "the error budget (fleet_slo_slow_total)")
+    p.add_argument("--slo_availability", type=float, default=0.999,
+                   help="availability objective in (0,1); the error "
+                        "BUDGET is 1 minus this, and burn rate is "
+                        "bad-fraction / budget per window "
+                        "(fleet_slo_burn_rate{window=5m|1h})")
+    p.add_argument("--slo_fast_burn", type=float, default=14.4,
+                   help="fast-window (5m) burn-rate page threshold; "
+                        "both windows breaching trips the watchdog and "
+                        "a coordinated fleet flight-recorder dump")
+    p.add_argument("--slo_slow_burn", type=float, default=6.0,
+                   help="slow-window (1h) burn-rate page threshold")
+    p.add_argument("--federation_poll_s", type=float, default=5.0,
+                   help="background scrape cadence for GET "
+                        "/metrics/fleet (replica /metrics re-exposed "
+                        "with a replica= label; render is cache-only)")
+    p.add_argument("--federation_timeout_s", type=float, default=2.0,
+                   help="per-replica scrape timeout: a replica dying "
+                        "mid-scrape costs the poller one timeout, "
+                        "never a client request")
+    p.add_argument("--federation_stale_s", type=float, default=60.0,
+                   help="age past which a dead replica's last-good "
+                        "series vanish from /metrics/fleet (only the "
+                        "fleet_federation_up 0 marker remains)")
+    p.add_argument("--flight_recorder_dir", default=None,
+                   help="enable the router flight recorder; an SLO "
+                        "burn-rate page triggers a COORDINATED dump "
+                        "(router bundle + every replica's "
+                        "/debug/flightrecorder) manifested here under "
+                        "one trigger trace id")
+    p.add_argument("--http_workers", type=int, default=128,
+                   help="router HTTP thread-pool size (bounded pool "
+                        "replaces thread-per-connection; sized for the "
+                        "10k-session load profile in bench_fleet.py)")
     return p
 
 

@@ -652,6 +652,12 @@ class ServeResult:
     # X-Model / X-Model-Version.
     model: Optional[str] = None
     model_version: Optional[str] = None
+    # Trace provenance (round 23 fleet observability): the sampled trace
+    # this request recorded spans under — None when unsampled (the
+    # common case).  The HTTP layer surfaces it as X-Trace-Id so a
+    # client can quote the exact id that finds the request's timeline in
+    # /debug/spans (and, across the router hop, the federated view).
+    trace_id: Optional[str] = None
 
     @property
     def degraded(self) -> bool:
@@ -1627,7 +1633,8 @@ class ServingEngine:
                deadline_ms: Optional[float] = None,
                tier: Optional[str] = None,
                degradable: bool = True,
-               model: Optional[str] = None) -> Future:
+               model: Optional[str] = None,
+               trace_context=None) -> Future:
         """Admit one stereo pair; returns a Future of ``ServeResult``.
 
         ``tier`` selects a configured latency tier (``ServeConfig.tiers``)
@@ -1665,6 +1672,14 @@ class ServingEngine:
         dispatch (the queue groups by model) and named models never
         route to the xl mesh (its replicated weights are the implicit
         model's).
+
+        ``trace_context`` (round 23) is an upstream ``TraceContext``
+        decoded from an inbound ``traceparent`` header: the request's
+        ``serve.request`` span ADOPTS that trace id and parents to the
+        caller's span (the fleet router's ``route.forward``), bypassing
+        this engine's local sample rate — the upstream sampling decision
+        already happened.  None (the default) keeps the local-sampling
+        behavior byte-for-byte.
         """
         t_admit = time.perf_counter()
         model = self.resolve_model(model)
@@ -1694,7 +1709,8 @@ class ServingEngine:
                 # kind brownout protects the rest of the fleet from.
                 return self._enqueue(left, right, deadline_ms, None,
                                      None, t_admit,
-                                     family=FAMILY_XL).future
+                                     family=FAMILY_XL,
+                                     trace_context=trace_context).future
             if want_xl:
                 raise ValueError(
                     f"tier 'xl': bucket {bucket[0]}x{bucket[1]} does "
@@ -1707,10 +1723,12 @@ class ServingEngine:
         tt = self.serve_cfg.tile_threshold_pixels
         if tt is not None and bucket[0] * bucket[1] > tt:
             return self._submit_tiled(left, right, deadline_ms, tier,
-                                      requested_tier, t_admit, model)
+                                      requested_tier, t_admit, model,
+                                      trace_context=trace_context)
         return self._enqueue(left, right, deadline_ms, tier,
                              requested_tier, t_admit,
-                             model=model).future
+                             model=model,
+                             trace_context=trace_context).future
 
     def _admit_tier(self, tier: Optional[str], degradable: bool
                     ) -> Tuple[Optional[str], Optional[str]]:
@@ -1736,7 +1754,8 @@ class ServingEngine:
                  scene_cut: bool = False,
                  frame_delta_v: Optional[float] = None,
                  ctx_init=None, hidden_init=None,
-                 model: Optional[str] = None) -> Request:
+                 model: Optional[str] = None,
+                 trace_context=None) -> Request:
         """Pad, build, trace, and queue one request — shared by the
         stateless ``submit`` (base family, no session fields) and the
         streaming ``submit_session``.  ``model`` is the RESOLVED
@@ -1775,12 +1794,22 @@ class ServingEngine:
             lambda f, m=model: self._note_pending(m, -1))
         # Sampled request: root span + admission (validate/pad) span; the
         # queue span opens here and closes at worker pickup (_run_chunk)
-        # or in the done-callback for requests dropped in the queue.
-        trace = self.tracer.start_trace(
-            "serve.request", bucket=str(req.bucket),
-            deadline_ms=deadline_ms,
+        # or in the done-callback for requests dropped in the queue.  An
+        # upstream trace context (the router's traceparent) ADOPTS the
+        # caller's trace id — serve.request parents to the router's
+        # route.forward span and the local sample rate is bypassed (the
+        # sampling decision already happened one hop up).
+        trace_attrs = dict(
+            bucket=str(req.bucket), deadline_ms=deadline_ms,
             **({"tier": tier} if tier is not None else {}),
             **({"session": session_id} if session_id is not None else {}))
+        if trace_context is not None:
+            trace = self.tracer.adopt_trace(trace_context,
+                                            "serve.request",
+                                            **trace_attrs)
+        else:
+            trace = self.tracer.start_trace("serve.request",
+                                            **trace_attrs)
         if trace is not None:
             req.trace = trace
             self.tracer.add_span("serve.admission", trace,
@@ -1823,18 +1852,21 @@ class ServingEngine:
               timeout: Optional[float] = None,
               tier: Optional[str] = None,
               degradable: bool = True,
-              model: Optional[str] = None) -> ServeResult:
+              model: Optional[str] = None,
+              trace_context=None) -> ServeResult:
         """Blocking convenience: submit + wait (the in-process client)."""
         return self.submit(left, right, deadline_ms, tier=tier,
-                           degradable=degradable,
-                           model=model).result(timeout=timeout)
+                           degradable=degradable, model=model,
+                           trace_context=trace_context
+                           ).result(timeout=timeout)
 
     # ------------------------------------------------------ tiled dispatch
     def _submit_tiled(self, left: np.ndarray, right: np.ndarray,
                       deadline_ms: Optional[float], tier: Optional[str],
                       requested_tier: Optional[str],
                       t_admit: float,
-                      model: Optional[str] = None) -> Future:
+                      model: Optional[str] = None,
+                      trace_context=None) -> Future:
         """Answer one beyond-threshold pair as N halo-overlap row tiles
         through the ORDINARY bucket path (serving/tiles.py): every tile
         is an equal-height `_enqueue` at the same bucket/tier/family, so
@@ -1856,12 +1888,16 @@ class ServingEngine:
             # Shorter than one tile extent: nothing to split.
             return self._enqueue(left, right, deadline_ms, tier,
                                  requested_tier, t_admit,
-                                 model=model).future
+                                 model=model,
+                                 trace_context=trace_context).future
+        # Every tile adopts the same upstream context: N serve.request
+        # subtrees under one trace id, all parented to the caller's span
+        # — the tiled answer reads as one fan-out in the timeline.
         reqs = [self._enqueue(
                     np.ascontiguousarray(left[s.src0:s.src1]),
                     np.ascontiguousarray(right[s.src0:s.src1]),
                     deadline_ms, tier, requested_tier, t_admit,
-                    model=model)
+                    model=model, trace_context=trace_context)
                 for s in specs]
         agg: Future = Future()
         state = {"remaining": len(reqs), "done": False}
@@ -1924,7 +1960,8 @@ class ServingEngine:
             attempts=max(res.attempts for res in results),
             tiles=len(reqs), seam_epe=seam,
             model=results[0].model,
-            model_version=results[0].model_version))
+            model_version=results[0].model_version,
+            trace_id=results[0].trace_id))
 
     # ---------------------------------------------------- streaming sessions
     def submit_session(self, session_id: str, left: np.ndarray,
@@ -1933,7 +1970,8 @@ class ServingEngine:
                        tier: Optional[str] = None,
                        degradable: bool = True,
                        handoff_key: Optional[str] = None,
-                       model: Optional[str] = None) -> Future:
+                       model: Optional[str] = None,
+                       trace_context=None) -> Future:
         """Admit one frame of a streaming session (the engine behind
         ``POST /v1/stream/<session>``).  Returns a Future of
         ``ServeResult`` whose session fields say what happened:
@@ -2070,7 +2108,7 @@ class ServingEngine:
                 ctx_init=ctx_init,
                 thumb=thumb, frame_index=sess.frame_index,
                 scene_cut=scene_cut, frame_delta_v=delta,
-                model=req_model)
+                model=req_model, trace_context=trace_context)
         except BaseException:
             sess.order_lock.release()
             raise
@@ -2085,12 +2123,14 @@ class ServingEngine:
                       tier: Optional[str] = None,
                       degradable: bool = True,
                       handoff_key: Optional[str] = None,
-                      model: Optional[str] = None) -> ServeResult:
+                      model: Optional[str] = None,
+                      trace_context=None) -> ServeResult:
         """Blocking convenience: submit_session + wait."""
         return self.submit_session(
             session_id, left, right, deadline_ms, tier=tier,
             degradable=degradable, handoff_key=handoff_key,
-            model=model).result(timeout=timeout)
+            model=model,
+            trace_context=trace_context).result(timeout=timeout)
 
     # ------------------------------------------------------ session handoff
     def exec_config_fingerprint(self) -> str:
@@ -3270,7 +3310,8 @@ class ServingEngine:
                 hidden=hidden_i,
                 warm_hidden=(family in _H_IN_FAMILIES),
                 model=bundle.name,
-                model_version=bundle.version))
+                model_version=bundle.version,
+                trace_id=exemplar))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,
                                      time.perf_counter())

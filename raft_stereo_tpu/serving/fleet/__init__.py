@@ -14,6 +14,9 @@ See docs/architecture.md §Fleet.
 * ``rollout``    — canary/shadow rollout policy (deterministic traffic
                    split onto a registered model version + hysteresis
                    auto-demotion; round 21 multi-model serving)
+* ``federation`` — metrics federation: background replica scraper +
+                   ``replica=``-labelled re-exposition (round 23
+                   fleet observability)
 * ``http``       — the router's HTTP front end (``raft-route``)
 """
 
@@ -22,6 +25,9 @@ from raft_stereo_tpu.serving.fleet.autoscaler import (AutoscaleConfig,
                                                       LocalProcessLauncher,
                                                       ReplicaLauncher,
                                                       serve_argv_template)
+from raft_stereo_tpu.serving.fleet.federation import (MetricsFederator,
+                                                      inject_label,
+                                                      relabel_exposition)
 from raft_stereo_tpu.serving.fleet.http import (RouterHTTPServer,
                                                 make_router_handler,
                                                 retry_after_jittered)
@@ -42,4 +48,5 @@ __all__ = ["DEFAULT_VNODES", "HashRing", "Replica", "ReplicaHealth",
            "RouterHTTPServer", "make_router_handler",
            "retry_after_jittered", "FleetLedger", "Autoscaler",
            "AutoscaleConfig", "ReplicaLauncher", "LocalProcessLauncher",
-           "serve_argv_template", "RolloutConfig", "RolloutPolicy"]
+           "serve_argv_template", "RolloutConfig", "RolloutPolicy",
+           "MetricsFederator", "inject_label", "relabel_exposition"]

@@ -24,6 +24,24 @@ surface:
   split onto a registered canary version; ``{"action": "clear"}``
   disarms; GET returns the live status (fractions, shadow-EPE window,
   demotion state).
+* ``GET /metrics/fleet`` — the federated exposition (fleet/federation.py):
+  the router's own registry plus every replica's last-scraped series
+  re-labelled ``replica="<name>"``, with per-replica up/staleness
+  gauges.  Cache-only on this path — the background poller does the
+  scraping, so a dead replica can never hang a federation request.
+* ``GET /debug/spans?trace=<id>`` — the FEDERATED trace view: the
+  router's own spans for that id merged with every replica's
+  (``route.request`` parent, ``serve.request`` child — the whole
+  cross-process story under one trace id).  Without ``?trace=`` the
+  router's own ring renders as Chrome trace JSON, and ``/debug/stacks``
+  + ``/debug/flightrecorder`` expose the router process itself — the
+  same per-process debug surface replicas carry.
+
+When router-side tracing is on (``--trace_sample_rate``), sampled
+requests answer with ``X-Trace-Id`` — including the router-originated
+error responses below, so a client quoting a failure quotes the id that
+finds it.  At the default rate 0 no header is added anywhere and
+forwarding stays byte-verbatim.
 
 Fleet-level typed errors (these are the ONLY responses the router
 originates on the request path):
@@ -35,8 +53,8 @@ originates on the request path):
   Fired once per session: the client's next frame reseeds cold on a
   surviving replica (the r14 410 contract, fleet-wide).
 
-Per-replica debug endpoints (``/debug/*``) are deliberately NOT proxied
-— they are about one process and should be hit on that process.
+Both count toward the SLO error totals (router.slo_errors) — fleet-typed
+failures burn error budget exactly like replica-side ones.
 """
 
 from __future__ import annotations
@@ -46,15 +64,19 @@ import logging
 import math
 import random
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from raft_stereo_tpu.serving.fleet.router import (FleetRouter,
                                                   NoReplicasAvailable,
                                                   SessionLost,
                                                   XlUnavailable)
 from raft_stereo_tpu.serving.http import MAX_BODY_BYTES, _stream_session_id
+from raft_stereo_tpu.telemetry.http import (handle_debug_get,
+                                            handle_debug_post)
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +99,11 @@ def make_router_handler(router: FleetRouter):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Under the pooled server a keep-alive connection occupies one
+        # worker until it closes; an idle read past this bound drops the
+        # connection (handle_one_request treats the socket timeout as
+        # close_connection) so parked clients cannot starve the pool.
+        timeout = 30.0
 
         def log_message(self, fmt, *args):
             log.debug("%s " + fmt, self.client_address[0], *args)
@@ -120,46 +147,84 @@ def make_router_handler(router: FleetRouter):
             path_qs = url.path + (f"?{url.query}" if url.query else "")
             headers = list(self.headers.items())
             session_id = _stream_session_id(url.path, self.headers)
+            if session_id == "":
+                self._reply_json(400, {
+                    "error": "stream requests need a session "
+                             "id: /v1/stream/<id> or "
+                             "X-Session-Id"})
+                return
+            # Sampling decision for the whole routed request; at the
+            # default rate 0 this is None in constant time and nothing
+            # below adds a span or touches a header.
+            trace = router.tracer.start_trace(
+                "route.request", method=method, path=url.path,
+                **({"session": session_id} if session_id else {}))
+            trace_hdrs = ([("X-Trace-Id", trace.trace_id)]
+                          if trace is not None else [])
+            t0 = time.perf_counter()
+            status_out: Optional[int] = None
             try:
-                if session_id is not None:
-                    if session_id == "":
-                        self._reply_json(400, {
-                            "error": "stream requests need a session "
-                                     "id: /v1/stream/<id> or "
-                                     "X-Session-Id"})
-                        return
-                    status, h, payload = router.forward_session(
-                        session_id, method, path_qs, body, headers)
-                else:
-                    status, h, payload = router.forward_stateless(
-                        method, path_qs, body, headers)
-            except SessionLost as e:
-                self._reply_json(410, {
-                    "error": "session_lost",
-                    "session_id": e.session_id,
-                    "replica": e.replica,
-                    "detail": str(e)})
-                return
-            except XlUnavailable as e:
-                retry_s, header = retry_after_jittered()
-                self._reply_json(
-                    503, {"error": "xl_unavailable",
-                          "capable_replicas": e.capable_ready,
-                          "capable_total": e.capable_total,
-                          "retry_after_s": retry_s, "detail": str(e)},
-                    extra_headers=[("Retry-After", header)])
-                return
-            except NoReplicasAvailable as e:
-                # The r13 typed-overload contract at fleet level: the
-                # machine-readable body plus a JITTERED Retry-After so
-                # synchronized clients do not retry in lockstep.
-                retry_s, header = retry_after_jittered()
-                self._reply_json(
-                    503, {"error": "no_replicas_ready",
-                          "retry_after_s": retry_s, "detail": str(e)},
-                    extra_headers=[("Retry-After", header)])
-                return
-            self._reply_forwarded(status, h, payload)
+                try:
+                    if session_id is not None:
+                        status, h, payload = router.forward_session(
+                            session_id, method, path_qs, body, headers,
+                            trace=trace)
+                    else:
+                        status, h, payload = router.forward_stateless(
+                            method, path_qs, body, headers, trace=trace)
+                except SessionLost as e:
+                    status_out = 410
+                    self._reply_json(410, {
+                        "error": "session_lost",
+                        "session_id": e.session_id,
+                        "replica": e.replica,
+                        "detail": str(e)},
+                        extra_headers=trace_hdrs)
+                    return
+                except XlUnavailable as e:
+                    status_out = 503
+                    retry_s, header = retry_after_jittered()
+                    self._reply_json(
+                        503, {"error": "xl_unavailable",
+                              "capable_replicas": e.capable_ready,
+                              "capable_total": e.capable_total,
+                              "retry_after_s": retry_s,
+                              "detail": str(e)},
+                        extra_headers=[("Retry-After", header)]
+                        + trace_hdrs)
+                    return
+                except NoReplicasAvailable as e:
+                    # The r13 typed-overload contract at fleet level:
+                    # the machine-readable body plus a JITTERED
+                    # Retry-After so synchronized clients do not retry
+                    # in lockstep.
+                    status_out = 503
+                    retry_s, header = retry_after_jittered()
+                    self._reply_json(
+                        503, {"error": "no_replicas_ready",
+                              "retry_after_s": retry_s,
+                              "detail": str(e)},
+                        extra_headers=[("Retry-After", header)]
+                        + trace_hdrs)
+                    return
+                status_out = status
+                respond_t0 = time.perf_counter()
+                if trace is not None and not any(
+                        k.lower() == "x-trace-id" for k, _v in h):
+                    # Surface the id to the client; the replica usually
+                    # already stamped the same one (it adopted our
+                    # context), in which case its header relays as-is.
+                    h = list(h) + [("X-Trace-Id", trace.trace_id)]
+                self._reply_forwarded(status, h, payload)
+                router.tracer.add_span("route.respond", trace,
+                                       respond_t0, time.perf_counter(),
+                                       status=status)
+            finally:
+                router.note_latency((time.perf_counter() - t0) * 1e3)
+                if trace is not None:
+                    if trace.root is not None and status_out is not None:
+                        trace.root.set_attr("status", status_out)
+                    router.tracer.finish_trace(trace)
 
         def do_GET(self):
             url = urlparse(self.path)
@@ -167,6 +232,19 @@ def make_router_handler(router: FleetRouter):
             if path == "/metrics":
                 self._reply(200, router.registry.render_text().encode(),
                             "text/plain; version=0.0.4")
+            elif path == "/metrics/fleet":
+                text = router.federator.render(
+                    own_text=router.registry.render_text())
+                self._reply(200, text.encode(),
+                            "text/plain; version=0.0.4")
+            elif path == "/debug/spans" and parse_qs(url.query).get(
+                    "trace", [None])[0]:
+                trace_id = parse_qs(url.query)["trace"][0]
+                self._reply_json(200, router.federated_trace(trace_id))
+            elif handle_debug_get(path, url.query, router.tracer,
+                                  router.recorder, router.registry,
+                                  self._reply, self._reply_json):
+                pass
             elif path == "/healthz":
                 status = router.fleet_status()
                 self._reply_json(200, {
@@ -230,6 +308,9 @@ def make_router_handler(router: FleetRouter):
             if url.path == "/admin/rollout":
                 self._handle_rollout_post()
                 return
+            if handle_debug_post(url.path, router.recorder,
+                                 self._reply_json):
+                return
             if (url.path != "/v1/disparity"
                     and _stream_session_id(url.path, self.headers)
                     is None):
@@ -257,16 +338,49 @@ def make_router_handler(router: FleetRouter):
     return Handler
 
 
+class _PooledHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer spawns one OS thread PER CONNECTION — at 10k
+    concurrent sessions that is 10k stacks (~80 GB of virtual address
+    space and a scheduler meltdown before the router does any work).
+    This variant services connections from a bounded ThreadPoolExecutor:
+    accepts queue in the kernel backlog (``request_queue_size``), at
+    most ``max_workers`` requests execute concurrently, and an idle
+    keep-alive is reaped by the handler timeout so a parked client
+    releases its worker.  bench_fleet.py is the receipt: the 5k/10k
+    session legs run against exactly this server."""
+
+    request_queue_size = 1024
+    daemon_threads = True
+
+    def __init__(self, addr, handler, max_workers: int = 128):
+        super().__init__(addr, handler)
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="fleet-http")
+
+    def process_request(self, request, client_address):
+        # ThreadingMixIn's per-connection Thread(), routed through the
+        # bounded pool instead; process_request_thread still owns
+        # finish_request + shutdown_request error handling.
+        self._pool.submit(self.process_request_thread, request,
+                          client_address)
+
+    def server_close(self):
+        super().server_close()
+        self._pool.shutdown(wait=False)
+
+
 class RouterHTTPServer:
-    """Owns the router's ThreadingHTTPServer; same lifecycle surface as
-    serving/http.StereoHTTPServer (``port=0`` for tests, ``start`` for a
-    daemon thread, ``serve_forever`` for the CLI)."""
+    """Owns the router's HTTP server (bounded-pool variant); same
+    lifecycle surface as serving/http.StereoHTTPServer (``port=0`` for
+    tests, ``start`` for a daemon thread, ``serve_forever`` for the
+    CLI)."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
-                 port: int = 8550):
+                 port: int = 8550, max_workers: int = 128):
         self.router = router
-        self.server = ThreadingHTTPServer((host, port),
-                                          make_router_handler(router))
+        self.server = _PooledHTTPServer((host, port),
+                                        make_router_handler(router),
+                                        max_workers=max_workers)
         self._thread = None
 
     @property

@@ -24,8 +24,14 @@ import http.client
 import json
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
-from urllib.parse import urlparse
+from urllib.parse import quote, urlparse
+
+# EWMA smoothing for probe round-trip latency: ~0.3 weights the last
+# probe enough to track a degrading replica within a few health passes
+# without one GC pause dominating the estimate.
+_PROBE_EWMA_ALPHA = 0.3
 
 
 class ReplicaUnreachable(ConnectionError):
@@ -109,9 +115,14 @@ class Replica:
         self.alive = True
         self.health: Optional[ReplicaHealth] = None
         self.consecutive_failures = 0
+        # Stamped by the router whenever alive/ready flips — `/fleet`
+        # surfaces it so a flapping replica is visible as a recent
+        # timestamp, not hidden behind a binary up/down.
+        self.last_state_change_ts: Optional[float] = None
         self._lock = threading.Lock()
         self.requests_forwarded = 0
         self.transport_errors = 0
+        self.probe_latency_ms: Optional[float] = None
 
     def __repr__(self) -> str:
         return (f"Replica({self.name!r}, {self.url!r}, alive={self.alive}, "
@@ -167,6 +178,7 @@ class Replica:
         """One liveness + readiness probe; raises ``ReplicaUnreachable``
         on any transport failure (including a health-check blackhole —
         a replica that accepts the connection but never answers)."""
+        t0 = time.monotonic()
         status_h, _, body_h = self._request("GET", "/healthz", None, {},
                                             timeout)
         if status_h != 200:
@@ -179,6 +191,13 @@ class Replica:
                 self.name, f"/healthz body unparseable: {e}") from e
         status_r, _, body_r = self._request("GET", "/readyz", None, {},
                                             timeout)
+        rtt_ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            if self.probe_latency_ms is None:
+                self.probe_latency_ms = rtt_ms
+            else:
+                self.probe_latency_ms += _PROBE_EWMA_ALPHA * (
+                    rtt_ms - self.probe_latency_ms)
         try:
             r = json.loads(body_r)
         except ValueError:
@@ -223,15 +242,62 @@ class Replica:
             {"Content-Type": "application/json"}, timeout)
         return status == 200
 
+    # -------------------------------------------------- observability fetches
+    def get_metrics(self, timeout: float) -> str:
+        """This replica's ``GET /metrics`` Prometheus text, verbatim —
+        the federation poller's scrape unit."""
+        status, _, body = self._request("GET", "/metrics", None, {},
+                                        timeout)
+        if status != 200:
+            raise ReplicaUnreachable(self.name,
+                                     f"/metrics answered {status}")
+        return body.decode("utf-8", errors="replace")
+
+    def get_spans(self, trace_id: str, timeout: float) -> List[Dict]:
+        """One trace's span records from this replica's ring
+        (``GET /debug/spans?trace=<id>``) — the federated trace view's
+        per-replica half.  Empty list when the replica has no spans for
+        that id (or span tracing is off: typed 404)."""
+        status, _, body = self._request(
+            "GET", f"/debug/spans?trace={quote(trace_id)}", None, {},
+            timeout)
+        if status != 200:
+            return []
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return []
+        spans = doc.get("spans") if isinstance(doc, dict) else None
+        return spans if isinstance(spans, list) else []
+
+    def post_flightrecorder(self, timeout: float) -> Optional[Dict]:
+        """Force a flight-recorder bundle dump on this replica (``POST
+        /debug/flightrecorder``) — the coordinated fleet dump's fan-out
+        leg.  Returns the replica's bundle record, or None when the
+        replica runs without a recorder (typed 404)."""
+        status, _, body = self._request("POST", "/debug/flightrecorder",
+                                        None, {}, timeout)
+        if status != 200:
+            return None
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
     def stats(self) -> Dict[str, object]:
         with self._lock:
             forwarded = self.requests_forwarded
             errors = self.transport_errors
+            probe_ms = self.probe_latency_ms
         h = self.health
         return {
             "name": self.name, "url": self.url, "alive": self.alive,
             "ready": self.ready,
             "consecutive_failures": self.consecutive_failures,
+            "probe_latency_ms": (round(probe_ms, 3)
+                                 if probe_ms is not None else None),
+            "last_state_change_ts": self.last_state_change_ts,
             "requests_forwarded": forwarded,
             "transport_errors": errors,
             "queue_depth": h.queue_depth if h else None,

@@ -79,19 +79,27 @@ import dataclasses
 import http.client
 import json
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from raft_stereo_tpu.serving.fleet.federation import MetricsFederator
 from raft_stereo_tpu.serving.fleet.ledger import FleetLedger
 from raft_stereo_tpu.serving.fleet.replica import (Replica, ReplicaHealth,
                                                    ReplicaUnreachable)
 from raft_stereo_tpu.serving.fleet.ring import DEFAULT_VNODES, HashRing
 from raft_stereo_tpu.serving.fleet.rollout import (RolloutConfig,
                                                    RolloutPolicy)
+from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
 from raft_stereo_tpu.telemetry.registry import MetricsRegistry
+from raft_stereo_tpu.telemetry.slo import BurnRateTracker, SloWatchdog
+from raft_stereo_tpu.telemetry.spans import (TRACE_CONTEXT_HEADER,
+                                             SpanTracer, Trace,
+                                             encode_traceparent)
+from raft_stereo_tpu.telemetry.watchdog import AnomalySink
 
 log = logging.getLogger(__name__)
 
@@ -192,6 +200,31 @@ class RouterConfig:
     # it detects a kill -9 faster than lease staleness alone.
     peer_url: Optional[str] = None
     peer_fail_after: int = 2
+    # ---- fleet observability (round 23) -------------------------------
+    # Router-side span sampling.  0.0 (default) keeps the pass-through
+    # contract BIT-EXACT: no route.request trace, no traceparent header
+    # injected, request and response bytes forwarded verbatim.
+    trace_sample_rate: float = 0.0
+    # SLO objectives (GET /metrics/fleet burn-rate gauges).  slo_ms:
+    # router-observed forward latency above this counts as an SLO error
+    # (None: latency does not burn budget); slo_availability is the
+    # objective the burn rate is measured against.
+    slo_ms: Optional[float] = None
+    slo_availability: float = 0.999
+    # Multi-window burn thresholds the SloWatchdog pages on (fast=first
+    # window, slow=last): both must breach simultaneously.
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 6.0
+    # Metrics federation poller (GET /metrics/fleet): background scrape
+    # cadence, per-replica scrape timeout, and how long a dead replica's
+    # last-good series stay exposed (stale-marked) before vanishing.
+    federation_poll_s: float = 5.0
+    federation_timeout_s: float = 2.0
+    federation_stale_s: float = 60.0
+    # Router-side flight-recorder bundles + the coordinated fleet dump
+    # manifests land here.  None: no recorder, SLO breaches still fire
+    # anomaly events but capture nothing.
+    flight_recorder_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.fail_after < 1:
@@ -215,6 +248,16 @@ class RouterConfig:
         if self.lease_ttl_s <= 0:
             raise ValueError(f"lease_ttl_s={self.lease_ttl_s} must be "
                              f"> 0")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(f"trace_sample_rate="
+                             f"{self.trace_sample_rate} must be in "
+                             f"[0, 1]")
+        if not 0.0 < self.slo_availability < 1.0:
+            raise ValueError(f"slo_availability="
+                             f"{self.slo_availability} must be in "
+                             f"(0, 1)")
+        if self.slo_ms is not None and self.slo_ms <= 0:
+            raise ValueError(f"slo_ms={self.slo_ms} must be > 0")
 
 
 class FleetRouter:
@@ -343,6 +386,55 @@ class FleetRouter:
         self._routed_by_kind: Dict[str, object] = {}
         self._per_replica_lock = threading.Lock()
         self._routed_by_replica: Dict[str, object] = {}
+        # ---- fleet observability (round 23) ---------------------------
+        # Router-side spans: at the default sample rate 0 start_trace
+        # returns None in constant time and every span call below is a
+        # no-op — the pass-through contract stays bit-exact.
+        self.tracer = SpanTracer(sample_rate=cfg.trace_sample_rate)
+        self.recorder: Optional[FlightRecorder] = None
+        if cfg.flight_recorder_dir:
+            self.recorder = FlightRecorder(cfg.flight_recorder_dir,
+                                           tracer=self.tracer,
+                                           registry=r)
+        # Typed fleet-level failures the replicas never see (503
+        # no_replicas_ready / xl_unavailable, 410 session_lost) — these
+        # MUST burn SLO budget too, or the burn rate only measures
+        # replica-side badness and a dead fleet looks healthy.
+        self.slo_errors = r.counter(
+            "fleet_slo_errors_total",
+            "router-typed request failures counted against the SLO "
+            "error budget (no_replicas_ready, xl_unavailable, "
+            "session_lost)")
+        self.slo_slow = r.counter(
+            "fleet_slo_slow_total",
+            "forwarded requests whose router-observed latency exceeded "
+            "the --slo_ms objective (counted against the error budget)")
+        self.anomalies = r.counter(
+            "fleet_anomalies_total",
+            "fleet-level anomalies fired (SLO burn-rate breaches)")
+        self.slo = BurnRateTracker(availability=cfg.slo_availability,
+                                   latency_ms=cfg.slo_ms, registry=r,
+                                   clock=clock)
+        self._sink = AnomalySink(recorder=self.recorder,
+                                 counter=self.anomalies)
+        self.slo_watchdog = SloWatchdog(self.slo, self._sink,
+                                        fast_burn=cfg.slo_fast_burn,
+                                        slow_burn=cfg.slo_slow_burn,
+                                        dump_fn=self.coordinated_dump)
+        self.fleet_dumps: List[Dict[str, object]] = []
+        self.federator = MetricsFederator(
+            self._federation_members, poll_s=cfg.federation_poll_s,
+            timeout_s=cfg.federation_timeout_s,
+            stale_after_s=cfg.federation_stale_s)
+
+    def _federation_members(self) -> List[Tuple[str, Replica]]:
+        """The federation poller's scrape set: every ALIVE replica —
+        in-rotation plus draining ones (their last metrics are exactly
+        what a post-incident look wants); dead replicas age out of the
+        cache instead of burning a scrape timeout every pass."""
+        with self._lock:
+            return [(name, rep) for name, rep in self.replicas.items()
+                    if rep.alive]
 
     # ---------------------------------------------------------------- metrics
     def _note_routed(self, kind: str, replica: str) -> None:
@@ -376,6 +468,7 @@ class FleetRouter:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="fleet-health")
         self._thread.start()
+        self.federator.start()
         return self
 
     def _run(self) -> None:
@@ -395,9 +488,14 @@ class FleetRouter:
                 self.rollout.poll()
             except Exception:  # pragma: no cover — loop must not die
                 log.exception("rollout poll failed")
+            try:
+                self.slo_tick()
+            except Exception:  # pragma: no cover — loop must not die
+                log.exception("SLO tick failed")
 
     def stop(self) -> None:
         self._stop.set()
+        self.federator.stop()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
@@ -434,6 +532,7 @@ class FleetRouter:
                 if health.ready and not in_ring:
                     self.ring.add(rep.name)
                     self._drain_pending.pop(rep.name, None)
+                    rep.last_state_change_ts = time.time()
                     self._transitions.append({
                         "t": self._clock(), "replica": rep.name,
                         "event": ("rejoined" if was_dead else "ready")})
@@ -485,6 +584,7 @@ class FleetRouter:
         self._bound_ledgers_locked()
         self.sessions_lost.inc(len(lost))
         self.failovers.inc()
+        rep.last_state_change_ts = time.time()
         self._transitions.append({
             "t": now, "replica": rep.name, "event": "removed",
             "reason": reason, "sessions_lost": len(lost)})
@@ -521,6 +621,7 @@ class FleetRouter:
         if rep.name in self.ring:
             self.ring.remove(rep.name)
             self._note_ready_locked()
+            rep.last_state_change_ts = time.time()
             self._transitions.append({
                 "t": self._clock(), "replica": rep.name,
                 "event": "draining"})
@@ -732,6 +833,112 @@ class FleetRouter:
                     epoch)
         return epoch
 
+    # ------------------------------------------------- fleet observability
+    def slo_tick(self) -> Dict[str, float]:
+        """One burn-rate sample + watchdog evaluation (public: the
+        health loop drives it on the poll cadence; tests and the smoke
+        call it directly for deterministic stepping).  Good = summed
+        replica admissions; bad = summed replica deadline misses plus
+        the router's OWN typed failures and slow forwards — the
+        satellite-6 fix that makes a dead fleet burn budget even though
+        no replica ever saw those requests."""
+        with self._lock:
+            admitted = missed = 0
+            for rep in self.replicas.values():
+                if rep.health is None:
+                    continue
+                admitted += rep.health.admitted
+                missed += rep.health.deadline_missed
+        bad = missed + self.slo_errors.value + self.slo_slow.value
+        burns = self.slo.sample(float(admitted), float(bad))
+        self.slo_watchdog.check(burns)
+        return burns
+
+    def note_latency(self, elapsed_ms: float) -> None:
+        """Router-observed end-to-end latency for one forwarded request
+        (fleet/http.py clocks it): above the ``--slo_ms`` objective it
+        burns error budget like a failure — a fleet that answers
+        everything slowly is NOT meeting its SLO."""
+        if self.cfg.slo_ms is not None and elapsed_ms > self.cfg.slo_ms:
+            self.slo_slow.inc()
+
+    def coordinated_dump(self, trigger_trace_id: str,
+                         detail: Optional[Dict] = None
+                         ) -> Dict[str, object]:
+        """The fleet-wide capture an SLO breach triggers: one router
+        flight-recorder bundle + a forced ``POST /debug/flightrecorder``
+        on every alive replica, linked by ONE manifest keyed on the
+        trigger trace id — the post-incident artifact is a single file
+        naming every bundle, not N directories to correlate by mtime.
+        Bounded: each replica POST gets ``health_timeout_s``."""
+        router_bundle = None
+        if self.recorder is not None:
+            router_bundle = self.recorder.dump(
+                "fleet_coordinated", detail=detail, force=True)
+        with self._lock:
+            members = [(n, r) for n, r in self.replicas.items()
+                       if r.alive]
+        replica_bundles: Dict[str, object] = {}
+        for name, rep in members:
+            try:
+                replica_bundles[name] = rep.post_flightrecorder(
+                    self.cfg.health_timeout_s)
+            except ReplicaUnreachable:
+                replica_bundles[name] = None
+        manifest: Dict[str, object] = {
+            "trigger_trace_id": trigger_trace_id,
+            "router": self.cfg.router_name,
+            "router_bundle": router_bundle,
+            "replicas": replica_bundles,
+            "detail": detail or {},
+        }
+        if self.cfg.flight_recorder_dir:
+            os.makedirs(self.cfg.flight_recorder_dir, exist_ok=True)
+            path = os.path.join(self.cfg.flight_recorder_dir,
+                                f"fleet-{trigger_trace_id}.json")
+            with open(path, "w") as f:
+                json.dump(manifest, f, indent=2, default=str)
+            manifest["manifest_path"] = path
+        self.fleet_dumps.append(manifest)
+        log.warning("coordinated fleet dump (trigger trace %s): router "
+                    "bundle %s, %d replica bundle(s)", trigger_trace_id,
+                    router_bundle,
+                    sum(1 for b in replica_bundles.values() if b))
+        return manifest
+
+    def federated_trace(self, trace_id: str) -> Dict[str, object]:
+        """One trace id's spans merged across the fleet: the router's
+        own ring plus every alive replica's ``GET /debug/spans?trace=``
+        answer, each span tagged with its ``process`` — the whole
+        cross-process story behind one id.  Replicas without the trace
+        contribute nothing (the common case: only the owning replica
+        holds the server-side half); an unreachable replica is recorded
+        in ``sources`` as -1, never an error."""
+        spans: List[Dict[str, object]] = []
+        sources: Dict[str, int] = {}
+        if self.tracer is not None:
+            own = [dict(s.to_dict(), process="router")
+                   for s in self.tracer.spans()
+                   if s.trace_id == trace_id]
+            spans.extend(own)
+            sources["router"] = len(own)
+        with self._lock:
+            members = [(n, r) for n, r in self.replicas.items()
+                       if r.alive]
+        for name, rep in members:
+            try:
+                got = rep.get_spans(trace_id,
+                                    self.cfg.health_timeout_s)
+            except ReplicaUnreachable:
+                sources[name] = -1
+                continue
+            sources[name] = len(got)
+            spans.extend(dict(s, process=name) for s in got
+                         if isinstance(s, dict))
+        spans.sort(key=lambda s: (s.get("start_us") or 0.0))
+        return {"trace_id": trace_id, "sources": sources,
+                "spans": spans}
+
     # -------------------------------------------------------------- routing
     def _ready_replicas_locked(self) -> List[Replica]:
         return [r for r in self.replicas.values() if r.ready]
@@ -784,9 +991,11 @@ class FleetRouter:
                 self.lost_ledger_size.set(len(self._lost))
                 self._ledger_append("fired", sid=session_id,
                                     replica=entry[0])
+                self.slo_errors.inc()
                 raise SessionLost(session_id, entry[0])
             name = self.ring.lookup(session_id)
             if name is None:
+                self.slo_errors.inc()
                 raise NoReplicasAvailable(
                     "no ready replica to own this session")
             rep = self.replicas[name]
@@ -838,7 +1047,8 @@ class FleetRouter:
 
     def forward_stateless(self, method: str, path_qs: str,
                           body: Optional[bytes],
-                          headers: Sequence[Tuple[str, str]]
+                          headers: Sequence[Tuple[str, str]],
+                          trace: Optional[Trace] = None
                           ) -> Tuple[int, List[Tuple[str, str]], bytes]:
         """Forward one stateless request with transport-level failover:
         a replica that dies mid-request burns one attempt, the request
@@ -865,40 +1075,60 @@ class FleetRouter:
                 and urlparse(path_qs).path == "/v1/disparity"
                 and self.rollout.active
                 and not self._names_model(path_qs, headers)):
+            split_t0 = time.perf_counter()
             canary = self.rollout.assign(body)
             if canary is not None:
                 headers = list(headers) + [("X-Model", canary)]
             else:
                 shadow = self.rollout.wants_shadow(body)
+            self.tracer.add_span(
+                "route.canary_split", trace, split_t0,
+                time.perf_counter(),
+                arm=("canary" if canary else
+                     "shadow" if shadow else "baseline"))
         tried: List[str] = []
         last: Optional[ReplicaUnreachable] = None
         for attempt in range(self.cfg.route_retries):
+            pick_t0 = time.perf_counter()
             try:
                 rep = self.pick_stateless(exclude=tried,
                                           require_xl=require_xl)
             except XlUnavailable:
                 self.xl_unroutable.inc()
                 self.unroutable.inc()
+                self.slo_errors.inc()
                 raise
             except NoReplicasAvailable:
                 if last is None:
                     self.unroutable.inc()
+                    self.slo_errors.inc()
                     raise
                 break
             tried.append(rep.name)
             if attempt > 0:
                 self.route_retries.inc()
+            self.tracer.add_span("route.pick", trace, pick_t0,
+                                 time.perf_counter(), replica=rep.name,
+                                 attempt=attempt)
+            fwd_headers, fwd_span = self._traced_headers(
+                headers, trace, rep, attempt)
             try:
                 status, h, payload = rep.forward(
-                    method, path_qs, body, headers,
+                    method, path_qs, body, fwd_headers,
                     self.cfg.request_timeout_s)
             except ReplicaUnreachable as e:
+                if fwd_span is not None:
+                    fwd_span.set_attr("error", "transport")
+                    self.tracer.finish(fwd_span)
                 last = e
                 self.note_transport_failure(rep)
                 log.warning("stateless %s %s: replica %s died "
                             "mid-request (attempt %d); failing over",
                             method, path_qs, rep.name, attempt + 1)
                 continue
+            if fwd_span is not None:
+                fwd_span.set_attr("status", status)
+                self.tracer.finish(fwd_span)
             self._note_routed("stateless", rep.name)
             if canary is not None:
                 # 5xx means the canary arm failed the request; a 4xx is
@@ -915,9 +1145,34 @@ class FleetRouter:
             # min_samples).
             self.rollout.note_canary_result(False)
         self.unroutable.inc()
+        self.slo_errors.inc()
         raise NoReplicasAvailable(
             f"all {len(tried)} dispatch attempt(s) hit transport "
             f"failures (tried {tried}): {last}")
+
+    def _traced_headers(self, headers: Sequence[Tuple[str, str]],
+                        trace: Optional[Trace], rep: Replica,
+                        attempt: int):
+        """Per-attempt trace propagation: open one ``route.forward``
+        span and attach ``traceparent`` naming it, so the replica's
+        ``serve.request`` parents to the attempt that actually reached
+        it (a failover shows two forward children, the survivor owning
+        the server-side subtree).  The router OWNS the header while
+        tracing (a client-supplied value must not graft onto our
+        trace); untraced (sample rate 0) the headers pass through
+        UNTOUCHED — byte-verbatim contract, and a client's own
+        traceparent still reaches the replica."""
+        if trace is None:
+            return headers, None
+        span = self.tracer.start_span("route.forward", trace,
+                                      replica=rep.name, attempt=attempt)
+        if span is None:
+            return headers, None
+        fwd = [(k, v) for k, v in headers
+               if k.lower() != TRACE_CONTEXT_HEADER]
+        fwd.append((TRACE_CONTEXT_HEADER,
+                    encode_traceparent(trace.trace_id, span.span_id)))
+        return fwd, span
 
     # ------------------------------------------------------- shadow mirror
     def _mirror_shadow(self, path_qs: str, body: bytes,
@@ -979,12 +1234,17 @@ class FleetRouter:
 
     def _forward_session_once(self, session_id: str, method: str,
                               path_qs: str, body: Optional[bytes],
-                              headers: Sequence[Tuple[str, str]]
+                              headers: Sequence[Tuple[str, str]],
+                              trace: Optional[Trace] = None
                               ) -> Tuple[Replica, int,
                                          List[Tuple[str, str]], bytes]:
         """One sticky dispatch: pick the owner, tag the frame with its
         handoff artifact when the id was handed off, forward."""
+        pick_t0 = time.perf_counter()
         rep = self.pick_session(session_id)   # SessionLost / NoReplicas
+        self.tracer.add_span("route.pick", trace, pick_t0,
+                             time.perf_counter(), replica=rep.name,
+                             session=session_id)
         key = self._handoff_key(session_id)
         # The router OWNS this header: a client-supplied value must not
         # reach a replica (it would point the import at an arbitrary
@@ -993,11 +1253,20 @@ class FleetRouter:
                        if k.lower() != "x-handoff-artifact"]
         if key is not None:
             fwd_headers.append(("X-Handoff-Artifact", key))
+            self.tracer.add_span("route.handoff_remap", trace, pick_t0,
+                                 time.perf_counter(),
+                                 replica=rep.name,
+                                 artifact=str(key)[:16])
+        fwd_headers, fwd_span = self._traced_headers(
+            fwd_headers, trace, rep, 0)
         try:
             status, h, payload = rep.forward(
                 method, path_qs, body, fwd_headers,
                 self.cfg.request_timeout_s)
         except ReplicaUnreachable:
+            if fwd_span is not None:
+                fwd_span.set_attr("error", "transport")
+                self.tracer.finish(fwd_span)
             self.note_transport_failure(rep)
             with self._lock:
                 # pick_session recorded the route; the death path above
@@ -1009,7 +1278,11 @@ class FleetRouter:
                 self.lost_ledger_size.set(len(self._lost))
             self._ledger_append("fired", sid=session_id,
                                 replica=rep.name)
+            self.slo_errors.inc()
             raise SessionLost(session_id, rep.name) from None
+        if fwd_span is not None:
+            fwd_span.set_attr("status", status)
+            self.tracer.finish(fwd_span)
         if key is not None and status == 200:
             # Adopted: the inheriting replica now owns the live state.
             with self._lock:
@@ -1018,7 +1291,8 @@ class FleetRouter:
 
     def forward_session(self, session_id: str, method: str, path_qs: str,
                         body: Optional[bytes],
-                        headers: Sequence[Tuple[str, str]]
+                        headers: Sequence[Tuple[str, str]],
+                        trace: Optional[Trace] = None
                         ) -> Tuple[int, List[Tuple[str, str]], bytes]:
         """Forward one session-sticky request.  No transport failover:
         the session's state lives on exactly one replica, so a transport
@@ -1032,7 +1306,7 @@ class FleetRouter:
         the air."""
         self._await_drain_handoff(session_id)
         rep, status, h, payload = self._forward_session_once(
-            session_id, method, path_qs, body, headers)
+            session_id, method, path_qs, body, headers, trace=trace)
         if self._draining_503(status, payload):
             # The frame beat the router's probe to a draining replica.
             # Treat the typed shed AS the drain signal: out of
@@ -1042,9 +1316,13 @@ class FleetRouter:
             # admitted it, so the retry cannot double-dispatch.
             with self._lock:
                 self._begin_drain_locked(rep)
+            remap_t0 = time.perf_counter()
             self._await_drain_handoff_for(rep)
+            self.tracer.add_span("route.handoff_remap", trace, remap_t0,
+                                 time.perf_counter(), replica=rep.name,
+                                 reason="drain_race")
             retry_rep, status, h, payload = self._forward_session_once(
-                session_id, method, path_qs, body, headers)
+                session_id, method, path_qs, body, headers, trace=trace)
             log.info("session %s frame raced replica %s's drain; "
                      "re-routed to %s", session_id, rep.name,
                      retry_rep.name)
@@ -1214,5 +1492,8 @@ class FleetRouter:
                          else "primary" if self.active else "standby"),
                 "epoch": self.ledger.epoch if self.ledger else None,
                 "rollout": self.rollout.status(),
+                "slo": self.slo.status(),
+                "federation": self.federator.status(),
+                "fleet_dumps": len(self.fleet_dumps),
                 "transitions": list(self._transitions[-50:]),
             }

@@ -89,6 +89,12 @@ Endpoints:
   forced bundle dump, and the compile-cost registry's executable
   inventory (flops / bytes accessed / memory analysis per bucket
   executable; 404 unless ``ServeConfig.cost_telemetry``).
+* Trace propagation (round 23 fleet observability): an inbound
+  ``traceparent`` header (W3C-style, telemetry/spans.py codec) makes the
+  request's ``serve.request`` span a child of the upstream trace — the
+  fleet router injects one per forwarded hop so one trace id spans
+  router and replica.  Sampled/adopted requests answer with
+  ``X-Trace-Id`` for lookup via ``/debug/spans?trace=<id>``.
 
 ``ThreadingHTTPServer`` gives one Python thread per connection; the real
 concurrency limit is the service's bounded queue, which is the point —
@@ -115,6 +121,8 @@ from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
 from raft_stereo_tpu.telemetry.http import (handle_debug_get,
                                             handle_debug_post,
                                             handle_trace_post)
+from raft_stereo_tpu.telemetry.spans import (TRACE_CONTEXT_HEADER,
+                                             decode_traceparent)
 from raft_stereo_tpu.telemetry.trace import TraceCapture
 
 log = logging.getLogger(__name__)
@@ -438,6 +446,14 @@ def make_handler(service: StereoService,
                 model = query.get("model", [None])[0] or \
                     self.headers.get("X-Model")
                 degradable = self.headers.get("X-No-Degrade") is None
+                # Inbound trace context (round 23 fleet observability):
+                # a ``traceparent`` header — typically injected by the
+                # fleet router — makes this request's serve.request span
+                # a CHILD of the upstream trace, regardless of the local
+                # sample rate (the upstream sampling decision wins).
+                # Malformed headers decode to None and are ignored.
+                trace_context = decode_traceparent(
+                    self.headers.get(TRACE_CONTEXT_HEADER))
             except (ValueError, KeyError, OSError) as e:
                 self._reply_json(400, {"error": str(e)})
                 return
@@ -447,12 +463,14 @@ def make_handler(service: StereoService,
                         session_id, left, right, deadline_ms=deadline_ms,
                         tier=tier, degradable=degradable, model=model,
                         handoff_key=self.headers.get(
-                            "X-Handoff-Artifact"))
+                            "X-Handoff-Artifact"),
+                        trace_context=trace_context)
                 else:
                     result = service.infer(left, right,
                                            deadline_ms=deadline_ms,
                                            tier=tier, degradable=degradable,
-                                           model=model)
+                                           model=model,
+                                           trace_context=trace_context)
             except ModelUnknown as e:
                 # Typed admission contract: the request named a model
                 # this replica does not serve — 404, machine-readable.
@@ -514,6 +532,13 @@ def make_handler(service: StereoService,
                 ("X-Batch-Size", str(result.batch_size))]
             if result.iters_used is not None:
                 headers.append(("X-Iters-Used", str(result.iters_used)))
+            if result.trace_id is not None:
+                # Sampled (or trace-context-adopted) requests echo their
+                # trace id so a slow response can be looked up in
+                # /debug/spans?trace=<id> — on this replica and, when the
+                # fleet router originated the trace, in the router's
+                # federated view.
+                headers.append(("X-Trace-Id", result.trace_id))
             if result.tier is not None:
                 headers.append(("X-Tier", result.tier))
             if result.mesh is not None:

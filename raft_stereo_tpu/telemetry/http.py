@@ -15,7 +15,9 @@ serving/http.py, same response conventions):
 * ``GET /debug/spans`` — the span-tracer ring (telemetry/spans.py) as
   Chrome trace-event JSON: save the body, open it in Perfetto.  Latency-
   histogram exemplars (sampled trace IDs) ride along under ``?exemplars=1``
-  as a JSON wrapper instead of the bare trace.
+  as a JSON wrapper instead of the bare trace.  ``?trace=<id>`` filters to
+  ONE trace and answers plain JSON span records instead — the per-process
+  half of the fleet router's federated cross-process trace view.
 * ``GET /debug/stacks`` — a plain-text stack dump of every live thread
   (where is the loop stuck RIGHT NOW).
 * ``GET /debug/flightrecorder`` — recorder status: ring occupancy, dump
@@ -39,6 +41,7 @@ import json
 import logging
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs
 
 from raft_stereo_tpu.telemetry.flight_recorder import (FlightRecorder,
                                                        dump_all_stacks)
@@ -108,6 +111,15 @@ def handle_debug_get(path: str, query: str,
         if tracer is None:
             reply_json(404, {"error": "span tracing not wired on this "
                                       "endpoint"})
+            return True
+        trace_filter = parse_qs(query).get("trace", [None])[0]
+        if trace_filter:
+            # One trace's spans as plain JSON records (spans.jsonl
+            # schema) — the federation unit the fleet router's merged
+            # GET /debug/spans?trace=<id> collects from each replica.
+            spans = [s.to_dict() for s in tracer.spans()
+                     if s.trace_id == trace_filter]
+            reply_json(200, {"trace_id": trace_filter, "spans": spans})
             return True
         chrome = to_chrome_trace(tracer.spans())
         if "exemplars=1" in query:

@@ -28,6 +28,18 @@ Design constraints, in priority order:
 The export format is the Chrome trace-event JSON ``{"traceEvents": [...]}``
 with complete ("X") events — the least-common-denominator format that
 chrome://tracing, Perfetto, and speedscope all open directly.
+
+**Cross-process propagation (round 23).**  A trace no longer stops at a
+process boundary: ``encode_traceparent`` serializes a (trace id, parent
+span id) pair into a W3C-``traceparent``-style header value
+(``00-<trace-id>-<span-id>-<flags>``), ``decode_traceparent`` parses an
+inbound one, and ``SpanTracer.adopt_trace`` opens a LOCAL root span under
+the REMOTE parent — same trace id, so the fleet router's ``route.request``
+span and the replica's ``serve.request`` span tell one story under one id.
+Adoption honors the upstream sampling decision (the codec only travels on
+sampled traces), so a replica at ``sample_rate=0`` still records adopted
+traces — and still records nothing at all when no header arrives, which
+keeps the zero-overhead-when-disabled contract intact.
 """
 
 from __future__ import annotations
@@ -52,6 +64,61 @@ def _wall_us(perf_t: float) -> float:
 
 def _new_id(bits: int = 64) -> str:
     return f"{random.getrandbits(bits):0{bits // 4}x}"
+
+
+# ---------------------------------------------------------- trace context
+# The canonical propagation header, lowercase (HTTP header names are
+# case-insensitive; W3C Trace Context spells it lowercase).
+TRACE_CONTEXT_HEADER = "traceparent"
+
+_CONTEXT_VERSION = "00"
+_HEX = frozenset("0123456789abcdef")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """A trace's cross-process identity: which trace this request belongs
+    to and which remote span is the local root's parent.  ``sampled``
+    mirrors the W3C flags octet; an unsampled context is never emitted by
+    ``encode_traceparent`` (unsampled traces are ``None`` everywhere), but
+    a standards-shaped inbound header with flags ``00`` decodes to one so
+    the caller can ignore it."""
+
+    trace_id: str
+    parent_span_id: str
+    sampled: bool = True
+
+
+def encode_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace-id>-<span-id>-01``: the outbound header value carrying
+    one sampled trace across a process hop.  Id widths are whatever the
+    tracer minted (16-hex trace / 8-hex span ids here, vs W3C's 32/16) —
+    the decoder accepts any hex run, so the round-trip is exact and a
+    true W3C header from a foreign client parses too."""
+    return f"{_CONTEXT_VERSION}-{trace_id}-{span_id}-01"
+
+
+def decode_traceparent(value: Optional[str]) -> Optional[TraceContext]:
+    """Parse an inbound ``traceparent``-style header; ``None`` for a
+    missing or malformed value (propagation is best-effort — a broken
+    header degrades to an unpropagated request, never an error)."""
+    if not value:
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if version != _CONTEXT_VERSION:
+        return None
+    if not trace_id or not span_id or len(flags) != 2:
+        return None
+    if not (set(trace_id) <= _HEX and set(span_id) <= _HEX
+            and set(flags) <= _HEX):
+        return None
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None       # all-zero ids are the spec's "invalid" sentinel
+    return TraceContext(trace_id=trace_id, parent_span_id=span_id,
+                        sampled=bool(int(flags, 16) & 0x01))
 
 
 @dataclasses.dataclass
@@ -183,6 +250,28 @@ class SpanTracer:
         trace = Trace(_new_id(64), self)
         if name is not None:
             trace.root = self._open(name, trace, parent_id=None, attrs=attrs)
+        return trace
+
+    def adopt_trace(self, context: Optional[TraceContext],
+                    name: Optional[str] = None, **attrs
+                    ) -> Optional[Trace]:
+        """Continue a REMOTE trace locally: same trace id, local root span
+        parented under the remote span the context names.  The upstream
+        tracer already made the sampling decision (unsampled traces never
+        emit a context), so adoption bypasses the local ``sample_rate`` —
+        a replica at rate 0 still records the hop a tracing router asked
+        for, and records nothing otherwise.  ``None``/unsampled contexts
+        return ``None`` in constant time."""
+        if context is None or not context.sampled:
+            return None
+        with self._lock:
+            self.traces_started += 1
+            self.traces_sampled += 1
+        trace = Trace(context.trace_id, self)
+        if name is not None:
+            trace.root = self._open(name, trace,
+                                    parent_id=context.parent_span_id,
+                                    attrs=attrs)
         return trace
 
     def finish_trace(self, trace: Optional[Trace]) -> None:

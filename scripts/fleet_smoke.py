@@ -47,6 +47,23 @@ Round-18 legs (a SECOND fresh fleet + subprocess ``raft-route`` pair):
    stops, the scale-down DRAINS it via handoff, and zero typed session
    losses occur.
 
+Round-23 observability legs (on the live 3-replica fleet):
+
+10. **One trace id across the fleet** — a sampled routed request's
+    ``X-Trace-Id`` appears in the router's span ring AND the owning
+    replica's; the router's federated ``/debug/spans?trace=`` merges
+    both processes into one timeline (the replica's ``serve.request``
+    a child of the router's ``route.forward``).  ``/metrics/fleet``
+    re-exposes every replica's series under a ``replica=`` label with
+    one HELP/TYPE per family.  A forced SLO burn trips the watchdog
+    into exactly ONE coordinated flight-recorder dump: router bundle +
+    all three replicas' bundles, one manifest under the trigger trace
+    id.
+11. **Small-N load record** — ``bench_fleet.py --quick`` against stub
+    replicas -> FLEET_BENCH_OUT (default BENCH_FLEET_ci.json; the full
+    10k-session sweep is the repo-root ``bench_fleet.py`` ->
+    BENCH_FLEET_r23.json).
+
 Writes ``bench_record`` JSON to FLEET_OUT (default FLEET_r16.json) and
 the HA legs to FLEET_HA_OUT (default FLEET_HA_r18.json; CI pins
 FLEET_ci.json / FLEET_HA_ci.json and uploads both).  Exit 0 on success,
@@ -80,6 +97,8 @@ sys.path.insert(0, os.path.join(_REPO, "tools"))
 OUT = os.environ.get("FLEET_OUT", os.path.join(_REPO, "FLEET_r16.json"))
 HA_OUT = os.environ.get("FLEET_HA_OUT",
                         os.path.join(_REPO, "FLEET_HA_r18.json"))
+BENCH_OUT = os.environ.get("FLEET_BENCH_OUT",
+                           os.path.join(_REPO, "BENCH_FLEET_ci.json"))
 
 HW = (48, 64)
 ITERS = 2
@@ -148,6 +167,11 @@ class ReplicaProc:
              "--brownout",
              "--warmup_shape", f"{HW[0]}x{HW[1]}",
              "--executable_cache_dir", store,
+             # round 23: the coordinated fleet dump POSTs
+             # /debug/flightrecorder on every replica (--watchdog is
+             # what arms the recorder on the serve CLI)
+             "--watchdog", "--flight_recorder_dir",
+             os.path.join(workdir, f"fr-{name}"),
              "--drain_timeout_s", "60"],
             cwd=_REPO, env=env, stdout=self._log, stderr=self._log)
         self.ready_s = None
@@ -536,6 +560,124 @@ def ha_phase(ckpt: str, store: str, workdir: str, payload: bytes,
             r.cleanup()
 
 
+def observability_phase(replicas, workdir: str, payload: bytes) -> dict:
+    """Round-23 acceptance leg, on the live 3-replica fleet:
+
+    * one sampled request's trace id appears in BOTH the router's span
+      ring and the owning replica's, and the router's federated
+      ``/debug/spans?trace=`` merges them into one timeline;
+    * ``/metrics/fleet`` re-exposes every replica's series under a
+      ``replica=`` label behind one scrape;
+    * a forced SLO burn trips the watchdog into ONE coordinated
+      flight-recorder dump with a bundle from the router and every
+      replica, linked by the trigger trace id."""
+    from raft_stereo_tpu.serving.fleet import (FleetRouter, RouterConfig,
+                                               RouterHTTPServer)
+
+    record = {}
+    fr_dir = os.path.join(workdir, "fleet-recorder")
+    router = FleetRouter(
+        {r.name: r.url for r in replicas},
+        RouterConfig(health_poll_s=0.2, health_timeout_s=2.0,
+                     fail_after=2, request_timeout_s=300.0,
+                     fleet_brownout=False, trace_sample_rate=1.0,
+                     slo_ms=120_000.0,
+                     flight_recorder_dir=fr_dir)).start()
+    rserver = RouterHTTPServer(router, port=0).start()
+    try:
+        base = rserver.url
+        router.slo_tick()               # baseline burn-rate snapshot
+
+        # -- one trace id, two processes, one merged timeline ----------
+        status, headers, _ = _post(
+            f"{base}/v1/disparity", payload,
+            {"Content-Type": "application/x-npz"})
+        assert status == 200
+        tid = headers.get("X-Trace-Id")
+        assert tid, "sampled routed request must echo X-Trace-Id"
+        owners = []
+        for r in replicas:
+            _, _, b = _get(f"{r.url}/debug/spans?trace={tid}")
+            if any(s["name"] == "serve.request"
+                   for s in json.loads(b)["spans"]):
+                owners.append(r.name)
+        assert len(owners) == 1, (
+            f"exactly one replica must hold the server half: {owners}")
+        _, _, b = _get(f"{base}/debug/spans?trace={tid}")
+        view = json.loads(b)
+        procs = {s["process"] for s in view["spans"]}
+        assert procs == {"router", owners[0]}, procs
+        names = {s["name"] for s in view["spans"]}
+        assert {"route.request", "route.forward",
+                "serve.request"} <= names, names
+        serve_root = next(s for s in view["spans"]
+                          if s["name"] == "serve.request")
+        fwd_ids = {s["span_id"] for s in view["spans"]
+                   if s["name"] == "route.forward"}
+        assert serve_root["parent_id"] in fwd_ids, (
+            "the replica subtree must stitch under the router's "
+            "forward span")
+        record["trace"] = {"trace_id": tid, "owner": owners[0],
+                           "merged_spans": len(view["spans"])}
+        print(f"[fleet_smoke] trace {tid}: one id across router + "
+              f"{owners[0]}, {len(view['spans'])}-span merged "
+              f"timeline: OK", flush=True)
+
+        # -- metrics federation: one scrape, every replica labelled ----
+        router.federator.scrape_once()
+        _, _, b = _get(f"{base}/metrics/fleet")
+        text = b.decode()
+        for r in replicas:
+            assert (f'fleet_federation_up{{replica="{r.name}"}} 1'
+                    in text), f"{r.name} missing from federation"
+            assert re.search(
+                rf'serve_requests_admitted_total{{replica="{r.name}"',
+                text), f"{r.name} series not re-exposed"
+        assert text.count("# HELP serve_requests_admitted_total") == 1, \
+            "duplicate families must merge under one header"
+        n_series = sum(1 for ln in text.splitlines()
+                       if ln and not ln.startswith("#"))
+        record["federation"] = {"replicas": len(replicas),
+                                "series": n_series}
+        print(f"[fleet_smoke] /metrics/fleet: {len(replicas)} replicas "
+              f"federated, {n_series} series, one HELP per family: OK",
+              flush=True)
+
+        # -- forced SLO burn -> coordinated fleet dump -----------------
+        for _ in range(64):
+            router.slo_errors.inc()     # synthesized routed failures
+        burns = router.slo_tick()
+        assert burns["5m"] > 14.4 and burns["1h"] > 6.0, burns
+        assert len(router.fleet_dumps) == 1, (
+            "both windows breaching must trigger exactly ONE "
+            "coordinated dump")
+        manifest = router.fleet_dumps[0]
+        assert manifest["router_bundle"], "router bundle missing"
+        bundles = {n: v for n, v in manifest["replicas"].items() if v}
+        assert set(bundles) == {r.name for r in replicas}, (
+            f"every replica must contribute a bundle: "
+            f"{manifest['replicas']}")
+        assert os.path.isfile(manifest["manifest_path"])
+        assert manifest["trigger_trace_id"]
+        # latched: continuing to burn must not re-fire
+        router.slo_errors.inc()
+        router.slo_tick()
+        assert len(router.fleet_dumps) == 1
+        record["slo_dump"] = {
+            "trigger_trace_id": manifest["trigger_trace_id"],
+            "burn_5m": round(burns["5m"], 1),
+            "replica_bundles": len(bundles)}
+        print(f"[fleet_smoke] SLO burn {burns['5m']:.0f}x -> one "
+              f"coordinated dump, {len(bundles)} replica bundles + "
+              f"router bundle, manifest "
+              f"{os.path.basename(manifest['manifest_path'])}: OK",
+              flush=True)
+        return record
+    finally:
+        rserver.shutdown()
+        router.stop()
+
+
 def build_checkpoint_and_store(workdir: str) -> tuple:
     """Random-init the tiny architecture, save an orbax checkpoint, and
     run the compile farm over it -> the shared artifact store."""
@@ -633,6 +775,9 @@ def main() -> int:
             "(pass-through parity)")
         print("[fleet_smoke] router pass-through byte-identical: OK",
               flush=True)
+
+        # ---- 2b. round-23 observability leg (all 3 replicas alive) ---
+        obs_record = observability_phase(replicas, workdir, payload)
 
         # ---- 3. sessions: sticky streams across the fleet ------------
         sids = [f"cam-{i}" for i in range(8)]
@@ -862,11 +1007,20 @@ def main() -> int:
                     "inflight_answered": len(ok),
                     "readyz_503_observed": saw_503,
                     "exit_code": 0},
+                "observability": obs_record,
             },
         })
         print(json.dumps(rec))
         write_record(OUT, rec, indent=1)
         print(f"fleet smoke OK -> {OUT}")
+
+        # ---- 11. small-N router load record (bench_fleet --quick) ----
+        import bench_fleet
+
+        rc = bench_fleet.main(["--quick", "--skip_real",
+                               "--out", BENCH_OUT])
+        assert rc == 0, "quick bench_fleet leg failed"
+        print(f"fleet load record -> {BENCH_OUT}", flush=True)
         return 0
     except BaseException:
         for r in replicas:

@@ -19,7 +19,7 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 import pytest
@@ -117,7 +117,18 @@ class StubReplica:
         self.requests = []
         self.sessions = []
         self.stream_headers = []
+        self.stateless_headers = []
         self.brownout_levels = []
+        # Observability scripting (round 23): what GET /metrics serves
+        # (federation scrapes it), trace_id -> spans for GET
+        # /debug/spans?trace=, and a count of coordinated
+        # POST /debug/flightrecorder dumps.
+        self.metrics_text = (
+            "# HELP stub_requests_total Requests this stub handled.\n"
+            "# TYPE stub_requests_total counter\n"
+            f'stub_requests_total{{stub="{name}"}} 0\n')
+        self.spans = {}
+        self.flightrecorder_dumps = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -163,6 +174,14 @@ class StubReplica:
                         self._json(404, {"error": "no_handoff"})
                     else:
                         self._json(200, outer.handoff_manifest)
+                elif urlparse(self.path).path == "/metrics":
+                    self._send(200, outer.metrics_text.encode(),
+                               ctype="text/plain; version=0.0.4")
+                elif urlparse(self.path).path == "/debug/spans":
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = q.get("trace", [""])[0]
+                    self._json(200, {"trace_id": tid,
+                                     "spans": outer.spans.get(tid, [])})
                 else:
                     self._json(404, {"error": "no route"})
 
@@ -175,6 +194,13 @@ class StubReplica:
                     outer.brownout_levels.append(
                         json.loads(body)["level"])
                     self._json(200, {"status": "ok"})
+                    return
+                if path == "/debug/flightrecorder":
+                    # The coordinated-dump fan-out target (round 23).
+                    outer.flightrecorder_dumps += 1
+                    self._json(200, {"status": "dumped",
+                                     "bundle": f"/tmp/{outer.name}",
+                                     "trigger": "forced"})
                     return
                 if outer.draining and path.startswith("/v1/"):
                     # The engine's typed draining shed (begin_shutdown
@@ -197,6 +223,8 @@ class StubReplica:
                         extra=[("X-Session-Id", sid),
                                ("X-Warm", "1" if warm else "0")])
                 elif path == "/v1/disparity":
+                    outer.stateless_headers.append(
+                        {k: v for k, v in self.headers.items()})
                     self._send(
                         200, b"disp:" + outer.name.encode() + body,
                         ctype="application/x-npy",
