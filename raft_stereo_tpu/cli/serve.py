@@ -80,6 +80,16 @@ def build_service(args):
         breaker_cooldown_s=args.breaker_cooldown_s,
         brownout=args.brownout,
         brownout_exempt_tiers=exempt,
+        confidence=args.confidence,
+        confidence_floor=args.confidence_floor,
+        quality_drift_threshold=args.quality_drift_threshold,
+        quality_drift_reference=args.quality_drift_reference,
+        quality_availability=args.quality_availability,
+        brownout_spare_below=args.brownout_spare_below,
+        cascade=args.cascade,
+        cascade_draft=args.cascade_draft,
+        cascade_escalate=args.cascade_escalate,
+        cascade_threshold=args.cascade_threshold,
         executable_cache_dir=args.executable_cache_dir,
         executable_cache_max_bytes=args.executable_cache_max_bytes,
         executable_cache_read_only=args.executable_cache_read_only,
@@ -455,6 +465,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of tiers brownout must never "
                         "degrade (e.g. 'quality' for contractual full-"
                         "quality clients)")
+    # Quality observability (round 24; telemetry/quality.py).
+    p.add_argument("--confidence", action="store_true",
+                   help="serve per-request confidence maps: every "
+                        "answer derives a per-pixel confidence from the "
+                        "refinement loop's own convergence signals "
+                        "(X-Confidence header, ?format=npz/conf_png "
+                        "payloads, serve_confidence histograms, the "
+                        "quality SLO burn rate, and the PSI drift "
+                        "watchdog); off keeps programs, cache keys and "
+                        "wire bytes identical to the pre-confidence "
+                        "build")
+    p.add_argument("--confidence_floor", type=float, default=0.5,
+                   help="mean confidence below which a request burns "
+                        "quality SLO budget (serve_quality_bad_total)")
+    p.add_argument("--quality_drift_threshold", type=float, default=0.25,
+                   help="PSI threshold of the confidence drift watchdog "
+                        "(0.25 = the classic 'act' band; one typed "
+                        "quality_drift anomaly + flight-recorder bundle "
+                        "per excursion)")
+    p.add_argument("--quality_drift_reference", type=int, default=256,
+                   help="requests that freeze the drift watchdog's "
+                        "healthy reference distribution")
+    p.add_argument("--quality_availability", type=float, default=0.99,
+                   help="quality SLO objective: fraction of requests "
+                        "that must meet the confidence floor (0.99 = "
+                        "1%% low-confidence budget)")
+    p.add_argument("--brownout_spare_below", type=float, default=0.0,
+                   help="brownout victim selection: spare requests of "
+                        "tiers whose rolling mean confidence is below "
+                        "this (they already need the expensive "
+                        "program); 0 keeps the unconditional ladder; "
+                        "needs --confidence")
+    p.add_argument("--cascade", action="store_true",
+                   help="enable the ?tier=auto confidence-gated "
+                        "cascade: requests draft on the cheapest tier "
+                        "and re-run on the expensive one only when the "
+                        "draft's mean confidence is below "
+                        "--cascade_threshold (X-Escalated/X-Draft-Tier "
+                        "provenance); needs --confidence and >= 2 tiers")
+    p.add_argument("--cascade_draft", default=None,
+                   help="cascade draft tier (default: the cheapest "
+                        "rung of the cost ladder, e.g. turbo)")
+    p.add_argument("--cascade_escalate", default=None,
+                   help="cascade escalation tier (default: the most "
+                        "expensive rung, e.g. quality)")
+    p.add_argument("--cascade_threshold", type=float, default=0.5,
+                   help="draft mean confidence below which the cascade "
+                        "escalates")
     # Streaming sessions (warm-start video serving; serving/sessions.py).
     p.add_argument("--sessions", action="store_true",
                    help="enable streaming stereo sessions: POST "

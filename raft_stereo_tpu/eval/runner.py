@@ -74,7 +74,8 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
                  return_state: bool = False,
                  ctx: Optional[str] = None,
                  hidden_init: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 return_confidence: bool = False):
     """The one jitted inference program both the solo runner and the
     serving engine compile, per (padded shape, batch): cast -> forward ->
     optional half-precision fetch cast.  Built here so the two paths share
@@ -137,9 +138,22 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
       ``donate_images`` — same shapes/dtypes as the returned tree, so
       XLA can alias the state round-trip.
 
+    * ``return_confidence=True`` — the program additionally returns the
+      per-pixel confidence element (models/raft_stereo.py): one 2-tuple
+      ``(conf_low, conf_up)`` of the (N, Hp/f, Wp/f) feature-resolution
+      map and its convex-upsampled (N, Hp, Wp) full-res counterpart,
+      both float32 in (0, 1], derived from the refinement loop's own
+      convergence signals (final |Δdisparity|, trajectory EWMA, and —
+      adaptive — the iteration-budget fraction).  Appended after
+      ``iters_used`` and before the hidden tree.  Off (default) the
+      program is bitwise-identical to the pre-confidence build (pinned
+      by tests).  Composes with every streaming variant and with the
+      base signature; unsupported on the mesh path
+      (``make_forward_mesh``).
+
     Traced-input order (streaming): ``(variables, images1, images2
     [, flow_init][, hidden][, ctx])``; return order: ``(flow_up,
-    flow_low[, iters_used][, hidden][, ctx])``.
+    flow_low[, iters_used][, confidence][, hidden][, ctx])``.
 
     With ``model.config.quant == "int8"`` every variant expects the
     QUANTIZED variable tree (quant/core.quantize_variables) and
@@ -177,12 +191,15 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
                 hidden = extra[pos]
                 pos += 1
             ctx_init = extra[pos] if ctx == "reuse" else None
+            kwargs = ({"return_confidence": True} if return_confidence
+                      else {})
             out = model.apply(
                 variables if not quantized else prepare(variables),
                 img1, img2, iters=iters, test_mode=True,
                 flow_init=flow_init, ctx_init=ctx_init,
                 return_ctx=(ctx == "save"),
-                hidden_init=hidden, return_hidden=return_hidden)
+                hidden_init=hidden, return_hidden=return_hidden,
+                **kwargs)
             flow_up = out[1]
             if fetch_dtype is not None:
                 flow_up = flow_up.astype(fetch_dtype)
@@ -194,6 +211,9 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
             ret = (flow_up, out[0].astype(jnp.float32))
             src = 2
             if adaptive:
+                ret = ret + (out[src],)
+                src += 1
+            if return_confidence:
                 ret = ret + (out[src],)
                 src += 1
             if return_hidden:
@@ -217,12 +237,19 @@ def make_forward(model: RAFTStereo, iters: int, fetch_dtype=None,
     def fwd(variables, images1, images2):  # (N, Hp, Wp, 3)
         img1 = images1.astype(jnp.float32)
         img2 = images2.astype(jnp.float32)
+        kwargs = {"return_confidence": True} if return_confidence else {}
         out = model.apply(variables if not quantized
                           else prepare(variables),
-                          img1, img2, iters=iters, test_mode=True)
+                          img1, img2, iters=iters, test_mode=True,
+                          **kwargs)
         flow_up = out[1]
         if fetch_dtype is not None:
             flow_up = flow_up.astype(fetch_dtype)
+        if return_confidence:
+            # Base-signature confidence: (flow_up[, iters_used], conf) —
+            # the conf element is the model's (conf_low, conf_up) tuple.
+            return ((flow_up, out[2], out[3]) if adaptive
+                    else (flow_up, out[2]))
         return (flow_up, out[2]) if adaptive else flow_up
 
     return jax.jit(fwd, donate_argnums=(1, 2) if donate_images else ())

@@ -49,6 +49,19 @@ _STEM_EXTRA_BYTES_PER_PIXEL = 1180
 # gate that first made 16.5 MPix frames fit in round 2).
 _SEQ_FNET_HBM_FRACTION = 0.10
 
+# Confidence-map scale (px at feature resolution): the per-pixel
+# convergence score (final |Δdisparity| + half the trajectory EWMA) maps
+# to confidence as exp(-score/scale), so a pixel whose update magnitude
+# settled at the scale reads ~0.37 and a fully-settled pixel reads ~1.0.
+# Sized to the early-exit band the repo already operates in
+# (EARLY_EXIT_r12: tier thresholds 0.01..0.05 px MEAN |Δ| — individual
+# unconverged pixels sit orders of magnitude above that).
+CONFIDENCE_SCALE_PX = 0.25
+# Trajectory-decay EWMA weight: how much of the per-pixel update history
+# survives each iteration.  0.8 remembers roughly the last five updates —
+# enough to distinguish "just went quiet" from "has been quiet".
+CONFIDENCE_EWMA_DECAY = 0.8
+
 
 def sequential_fnet_threshold(cfg: RaftStereoConfig) -> int:
     """Pixel count above which fnet runs the two images sequentially.
@@ -104,7 +117,8 @@ class RAFTStereo(nn.Module):
                  iters: int = 12, flow_init: Optional[jnp.ndarray] = None,
                  test_mode: bool = False, unroll_gru: bool = False,
                  ctx_init=None, return_ctx: bool = False,
-                 hidden_init=None, return_hidden: bool = False):
+                 hidden_init=None, return_hidden: bool = False,
+                 return_confidence: bool = False):
         """Estimate disparity for a rectified stereo pair.
 
         Args:
@@ -161,10 +175,28 @@ class RAFTStereo(nn.Module):
             per-level hidden states (appended after ``iters_used`` and
             before the ctx bundle) so a streaming session can chain
             them.
+          return_confidence: test-mode only — also return a per-pixel
+            CONFIDENCE estimate derived from signals the refinement loop
+            already computes: the final iteration's per-pixel
+            |Δdisparity| magnitude, a decaying EWMA of the per-pixel
+            update trajectory (``CONFIDENCE_EWMA_DECAY``), and — on the
+            convergence-gated path — the fraction of the iteration
+            budget actually spent (``iters_used``; hitting the cap
+            without converging is the same distrust signal the keyframe
+            guard acts on).  The element is one 2-tuple
+            ``(conf_low, conf_up)``: the (B, H/f, W/f) feature-resolution
+            map in (0, 1] and its convex-upsampled (B, H, W) full-res
+            counterpart (reusing the final upsample mask — a convex
+            combination of confidences is itself a valid confidence).
+            Appended after ``iters_used`` and before ``hidden``/``ctx``.
+            Off (default) traces NO extra ops: the program stays
+            bitwise-identical (pinned by tests).  Unsupported with
+            ``rows_gru`` (the sharded loop executor owns its own state
+            layout).
 
         Return order (test mode): ``(flow_low, flow_up[, iters_used]
-        [, hidden][, ctx])`` — the optional tails appear only when their
-        flag is set, in that fixed order.
+        [, confidence][, hidden][, ctx])`` — the optional tails appear
+        only when their flag is set, in that fixed order.
         """
         cfg = self.config
         dtype = self.compute_dtype
@@ -182,6 +214,13 @@ class RAFTStereo(nn.Module):
             raise ValueError("hidden_init/return_hidden are unsupported "
                              "with rows_gru (the sharded loop executor "
                              "owns its own state layout)")
+        if return_confidence and not test_mode:
+            raise ValueError("return_confidence is test-mode only (the "
+                             "confidence map is an inference product)")
+        if return_confidence and cfg.rows_gru:
+            raise ValueError("return_confidence is unsupported with "
+                             "rows_gru (the sharded loop executor owns "
+                             "its own state layout)")
         if reuse_ctx and cfg.shared_backbone:
             raise ValueError(
                 "ctx_init is unsupported with shared_backbone: fnet is "
@@ -393,6 +432,21 @@ class RAFTStereo(nn.Module):
 
         if test_mode and unroll_gru:
             mask = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+            if return_confidence:
+                dmag = jnp.zeros((b, h8, w8), jnp.float32)
+                ewma = jnp.zeros((b, h8, w8), jnp.float32)
+                for _ in range(iters):
+                    net_list, new_disp, mask = gru_step(self, net_list,
+                                                        disp)
+                    dmag = jnp.abs(new_disp - disp)
+                    ewma = (CONFIDENCE_EWMA_DECAY * ewma
+                            + (1.0 - CONFIDENCE_EWMA_DECAY) * dmag)
+                    disp = new_disp
+                flow_up = self._upsample(disp, mask)
+                conf = self._confidence_maps(dmag, ewma, mask,
+                                             jnp.float32(1.0))
+                return ((disp, flow_up, conf)
+                        + hidden_tail(net_list) + ctx_tail)
             for _ in range(iters):
                 net_list, disp, mask = gru_step(self, net_list, disp)
             flow_up = self._upsample(disp, mask)
@@ -414,6 +468,44 @@ class RAFTStereo(nn.Module):
                      else min(iters, cfg.exit_max_iters))
             min_iters = max(1, min(cfg.exit_min_iters, limit))
             threshold = jnp.float32(cfg.exit_threshold_px)
+
+            if return_confidence:
+                # Confidence variant: the carry additionally tracks the
+                # per-pixel update magnitude (whose batch-mean max IS the
+                # exit predicate — computed once, used for both) and its
+                # decaying EWMA.  A distinct program by construction; the
+                # plain branch below stays bitwise-untouched.
+                def cond_exit_conf(module, carry):
+                    _net, _disp, _mask, it, delta, _dm, _ew = carry
+                    return jnp.logical_or(
+                        it < min_iters,
+                        jnp.logical_and(it < limit, delta >= threshold))
+
+                def body_exit_conf(module, carry):
+                    net_list, disp, _mask, it, _delta, _dm, ewma = carry
+                    net_list, new_disp, up_mask = gru_step(
+                        module, list(net_list), disp)
+                    dmag = jnp.abs(new_disp - disp).astype(jnp.float32)
+                    delta = jnp.max(jnp.mean(dmag, axis=(1, 2)))
+                    ewma = (CONFIDENCE_EWMA_DECAY * ewma
+                            + (1.0 - CONFIDENCE_EWMA_DECAY) * dmag)
+                    return (tuple(net_list), new_disp, up_mask,
+                            it + jnp.int32(1), delta, dmag, ewma)
+
+                mask0 = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+                zero_px = jnp.zeros((b, h8, w8), jnp.float32)
+                carry = (tuple(net_list), disp, mask0, jnp.int32(0),
+                         jnp.float32(jnp.inf), zero_px, zero_px)
+                (net_fin, disp_fin, mask_fin, iters_used, _delta,
+                 dmag_fin, ewma_fin) = (
+                    nn.while_loop(cond_exit_conf, body_exit_conf, self,
+                                  carry))
+                flow_up = self._upsample(disp_fin, mask_fin)
+                depth_frac = iters_used.astype(jnp.float32) / limit
+                conf = self._confidence_maps(dmag_fin, ewma_fin,
+                                             mask_fin, depth_frac)
+                return ((disp_fin, flow_up, iters_used, conf)
+                        + hidden_tail(net_fin) + ctx_tail)
 
             def cond_exit(module, carry):
                 _net, _disp, _mask, it, delta = carry
@@ -447,6 +539,36 @@ class RAFTStereo(nn.Module):
             # the latest mask) and upsampling happens once at the end
             # (reference skips intermediate upsampling in test mode —
             # core/raft_stereo.py:126-127).
+            if return_confidence:
+                # Confidence variant of the fixed-depth scan: the carry
+                # additionally tracks the per-pixel update magnitude and
+                # its EWMA.  Fixed depth spends the whole budget, so the
+                # depth fraction is 1 by construction.
+                def body_test_conf(module, carry, _):
+                    net_list, disp, _mask, _dm, ewma = carry
+                    net_list, new_disp, up_mask = gru_step(module,
+                                                           net_list, disp)
+                    dmag = jnp.abs(new_disp - disp).astype(jnp.float32)
+                    ewma = (CONFIDENCE_EWMA_DECAY * ewma
+                            + (1.0 - CONFIDENCE_EWMA_DECAY) * dmag)
+                    return (tuple(net_list), new_disp, up_mask,
+                            dmag, ewma), None
+
+                scan_conf = nn.scan(
+                    body_test_conf,
+                    variable_broadcast=("params", "batch_stats"),
+                    split_rngs={"params": False}, length=iters)
+                mask0 = jnp.zeros((b, h8, w8, cfg.mask_channels), dtype)
+                zero_px = jnp.zeros((b, h8, w8), jnp.float32)
+                (net_fin, disp_fin, mask_fin, dmag_fin, ewma_fin), _ = (
+                    scan_conf(self, (tuple(net_list), disp, mask0,
+                                     zero_px, zero_px), None))
+                flow_up = self._upsample(disp_fin, mask_fin)
+                conf = self._confidence_maps(dmag_fin, ewma_fin,
+                                             mask_fin, jnp.float32(1.0))
+                return ((disp_fin, flow_up, conf)
+                        + hidden_tail(net_fin) + ctx_tail)
+
             def body_test(module, carry, _):
                 net_list, disp, _mask = carry
                 net_list, disp, up_mask = gru_step(module, net_list, disp)
@@ -494,6 +616,26 @@ class RAFTStereo(nn.Module):
             up = convex_upsample(disp[..., None], mask.astype(jnp.float32),
                                  self.config.downsample_factor)
             return up[..., 0]
+
+    def _confidence_maps(self, dmag: jnp.ndarray, ewma: jnp.ndarray,
+                         mask: jnp.ndarray, depth_frac: jnp.ndarray):
+        """The ``return_confidence`` element: (conf_low, conf_up).
+
+        Per-pixel convergence score = final |Δdisparity| plus half the
+        trajectory EWMA (px at feature resolution), scaled up by the
+        fraction of the iteration budget spent (adaptive loops that
+        exited early earn a mild trust bonus; a loop that rode to its
+        cap gets none — the keyframe-guard distrust signal).  Confidence
+        is exp(-score/scale): 1.0 for fully-settled pixels, decaying on
+        the CONFIDENCE_SCALE_PX length scale.  The full-res map reuses
+        the final convex-upsample mask — a convex combination of
+        confidences is itself a confidence."""
+        with annotate("confidence"):
+            score = (dmag + 0.5 * ewma).astype(jnp.float32)
+            conf_low = jnp.exp(-score * (0.5 + 0.5 * depth_frac)
+                               / CONFIDENCE_SCALE_PX)
+            conf_up = jnp.clip(self._upsample(conf_low, mask), 0.0, 1.0)
+            return conf_low, conf_up
 
 
 def create_model(cfg: RaftStereoConfig):

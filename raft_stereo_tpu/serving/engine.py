@@ -443,6 +443,53 @@ class ServeConfig:
     # The registered model unnamed requests run (the default pointer a
     # hot swap flips); None = the implicit constructor model.
     default_model: Optional[str] = None
+    # ---- Quality observability (round 24; telemetry/quality.py) --------
+    # Compile the ``return_confidence`` program variants: every non-xl
+    # executable additionally returns the per-pixel confidence element
+    # derived from the refinement loop's own convergence signals
+    # (models/raft_stereo.py), results carry the unpadded full-res map +
+    # its mean, and each answered request lands in the
+    # serve_confidence{tier,model} histograms, the quality good/bad SLO
+    # counters, and the PSI drift watchdog.  False (default): no
+    # tracker, no new series, and every program / cost key / persist
+    # key / wire byte stays identical to the pre-confidence build
+    # (pinned by tests).
+    confidence: bool = False
+    # Mean confidence below which a request counts AGAINST the quality
+    # SLO budget (serve_quality_bad_total) — the split a quality
+    # BurnRateTracker burns on.
+    confidence_floor: float = 0.5
+    # PSI drift watchdog knobs (telemetry/quality.QualityDriftWatchdog):
+    # the index threshold that fires the typed quality_drift anomaly
+    # (0.25 = the classic "act" band), the healthy-reference sample
+    # count frozen at warm-up, and the rolling recent-window length.
+    quality_drift_threshold: float = 0.25
+    quality_drift_reference: int = 256
+    quality_drift_window: int = 128
+    # Quality SLO objective: the fraction of requests that may fall
+    # below the confidence floor before the quality error budget burns
+    # (0.99 = 1% of answers may be low-confidence).  Burns on the same
+    # multi-window machinery as availability (telemetry/slo.py,
+    # dimension="quality").
+    quality_availability: float = 0.99
+    # Brownout victim selection (serving/resilience.py): requests whose
+    # tier's recent rolling mean confidence sits below this are SPARED
+    # from degradation — they already need the expensive program.  0.0
+    # (default) keeps the unconditional ladder.  Requires confidence.
+    brownout_spare_below: float = 0.0
+    # ---- Confidence-gated cascade: the "auto" pseudo-tier --------------
+    # Requests naming ?tier=auto run the DRAFT tier first (default: the
+    # cheapest rung of the cost ladder, e.g. turbo) and escalate to the
+    # ESCALATE tier (default: the most expensive rung, e.g. quality)
+    # only when the draft's mean confidence falls below
+    # cascade_threshold — "turbo drafts, quality verifies" (ROADMAP
+    # item 2).  Oversized requests cascade per halo tile: only the
+    # low-confidence tiles re-run expensive.  Requires ``confidence``
+    # and at least two configured tiers.
+    cascade: bool = False
+    cascade_draft: Optional[str] = None
+    cascade_escalate: Optional[str] = None
+    cascade_threshold: float = 0.5
 
     def __post_init__(self):
         if self.data_parallel < 1:
@@ -586,6 +633,56 @@ class ServeConfig:
             raise ValueError(
                 f"default_model={self.default_model!r} is not one of the "
                 f"registered model names {model_names}")
+        if not 0.0 <= self.confidence_floor <= 1.0:
+            raise ValueError(f"confidence_floor={self.confidence_floor} "
+                             f"must be in [0, 1]")
+        if self.quality_drift_threshold <= 0:
+            raise ValueError(
+                f"quality_drift_threshold={self.quality_drift_threshold} "
+                f"must be > 0")
+        if not 0.0 < self.quality_availability < 1.0:
+            raise ValueError(
+                f"quality_availability={self.quality_availability} must "
+                f"be in (0, 1) — 1.0 leaves no quality budget to burn")
+        if self.brownout_spare_below and not self.confidence:
+            raise ValueError(
+                "brownout_spare_below needs confidence=True — the spare "
+                "signal IS the rolling confidence telemetry")
+        if not 0.0 <= self.brownout_spare_below <= 1.0:
+            raise ValueError(
+                f"brownout_spare_below={self.brownout_spare_below} must "
+                f"be in [0, 1]")
+        if self.cascade:
+            if not self.confidence:
+                raise ValueError("cascade=True needs confidence=True — "
+                                 "the escalation gate IS the confidence "
+                                 "signal")
+            if len(names) < 2:
+                raise ValueError(
+                    "cascade=True needs at least two configured tiers "
+                    "(a draft and an escalation target)")
+            for field_name, value in (("cascade_draft",
+                                       self.cascade_draft),
+                                      ("cascade_escalate",
+                                       self.cascade_escalate)):
+                if value is not None and value not in names:
+                    raise ValueError(
+                        f"{field_name}={value!r} is not one of the "
+                        f"configured tiers {names}")
+            if (self.cascade_draft is not None
+                    and self.cascade_draft == self.cascade_escalate):
+                raise ValueError(
+                    f"cascade_draft and cascade_escalate are both "
+                    f"{self.cascade_draft!r} — the cascade would never "
+                    f"change programs")
+            if not 0.0 <= self.cascade_threshold <= 1.0:
+                raise ValueError(
+                    f"cascade_threshold={self.cascade_threshold} must "
+                    f"be in [0, 1]")
+        elif self.cascade_draft is not None \
+                or self.cascade_escalate is not None:
+            raise ValueError("cascade_draft/cascade_escalate need "
+                             "cascade=True")
 
     def parsed_tiers(self) -> Tuple[RequestTier, ...]:
         return tuple(parse_tier(s) for s in self.tiers)
@@ -658,6 +755,18 @@ class ServeResult:
     # client can quote the exact id that finds the request's timeline in
     # /debug/spans (and, across the router hop, the federated view).
     trace_id: Optional[str] = None
+    # Quality provenance (round 24, ``ServeConfig.confidence``): the
+    # unpadded full-resolution (H, W) float32 confidence map in (0, 1]
+    # (None with confidence off and on xl/mesh dispatches), its mean
+    # (the scalar the telemetry, SLO, and cascade gate consume), and —
+    # cascade requests only — whether this answer came from the
+    # escalation tier, which tier drafted it, and the draft's mean
+    # confidence that triggered (or cleared) the escalation.
+    confidence: Optional[np.ndarray] = None
+    confidence_mean: Optional[float] = None
+    escalated: bool = False
+    draft_tier: Optional[str] = None
+    draft_confidence: Optional[float] = None
 
     @property
     def degraded(self) -> bool:
@@ -1075,6 +1184,63 @@ class ServingEngine:
                 poll_s=serve_cfg.brownout_poll_s,
                 gauge=self.metrics.brownout_level,
                 sink=_SinkRef(self)).start()
+            # Confidence-aware victim selection (round 24): requests at
+            # tiers whose recent answers were already low-confidence are
+            # spared from degradation (_admit_tier feeds the rolling
+            # mean).  0.0 (default) disables the check.
+            self.brownout.spare_below = serve_cfg.brownout_spare_below
+        # ---- Quality observability (round 24) --------------------------
+        # Per-request confidence telemetry + PSI drift watchdog
+        # (telemetry/quality.py); None with confidence off — no tracker,
+        # no series, the exposition stays byte-identical.  The drift
+        # watchdog fires through _SinkRef, so a sink attached after
+        # construction (the CLI order) is still reached.
+        self.quality = None
+        self._cascade_drafts = None
+        self._cascade_escalations = None
+        if serve_cfg.confidence:
+            from raft_stereo_tpu.telemetry.quality import QualityTracker
+            from raft_stereo_tpu.telemetry.slo import BurnRateTracker
+            # The quality error budget: the fraction of requests allowed
+            # below the confidence floor, burned on the same multi-window
+            # machinery as the fleet's availability budget — one more
+            # dimension label on the burn-rate gauge family.
+            quality_slo = BurnRateTracker(
+                availability=serve_cfg.quality_availability,
+                registry=self.metrics.registry,
+                gauge_name="serve_slo_burn_rate",
+                dimension="quality")
+            self.quality = QualityTracker(
+                registry=self.metrics.registry,
+                sink=_SinkRef(self),
+                floor=serve_cfg.confidence_floor,
+                drift_threshold=serve_cfg.quality_drift_threshold,
+                drift_reference_size=serve_cfg.quality_drift_reference,
+                drift_window=serve_cfg.quality_drift_window,
+                slo=quality_slo)
+        # Cascade tier resolution ("auto"): draft on the cheapest rung
+        # of the cost ladder, escalate to the most expensive, unless the
+        # config names either explicitly.
+        self._cascade_draft: Optional[str] = None
+        self._cascade_escalate: Optional[str] = None
+        if serve_cfg.cascade:
+            ladder = cost_ladder(serve_cfg.parsed_tiers())
+            self._cascade_draft = serve_cfg.cascade_draft or ladder[0]
+            self._cascade_escalate = (serve_cfg.cascade_escalate
+                                      or ladder[-1])
+            if self._cascade_draft == self._cascade_escalate:
+                raise ValueError(
+                    f"cascade draft and escalation tiers both resolve "
+                    f"to {self._cascade_draft!r} — configure "
+                    f"cascade_draft/cascade_escalate explicitly")
+            self._cascade_drafts = self.metrics.registry.counter(
+                "serve_cascade_draft_total",
+                "Cascade (tier=auto) requests answered by the draft "
+                "tier alone")
+            self._cascade_escalations = self.metrics.registry.counter(
+                "serve_cascade_escalated_total",
+                "Cascade (tier=auto) requests escalated to the "
+                "expensive tier on low draft confidence")
         # Persistent executable cache / shared artifact store
         # (serving/persist.py).
         self.disk_cache = None
@@ -1597,6 +1763,25 @@ class ServingEngine:
         ``service.batcher.depth``)."""
         return self.queue
 
+    def quality_status(self) -> Optional[Dict[str, object]]:
+        """Online quality posture (``GET /quality``): rolling per-tier
+        mean confidence, good/bad totals vs the floor, drift-watchdog
+        state, the quality SLO burn, and — with the cascade on — the
+        draft/escalation split.  None when confidence telemetry is off
+        (the endpoint 404s, keeping the off wire surface unchanged)."""
+        if self.quality is None:
+            return None
+        out = self.quality.status()
+        if self._cascade_draft is not None:
+            out["cascade"] = {
+                "draft": self._cascade_draft,
+                "escalate": self._cascade_escalate,
+                "threshold": self.serve_cfg.cascade_threshold,
+                "drafts": self._cascade_drafts.value,
+                "escalated": self._cascade_escalations.value,
+            }
+        return out
+
     # ------------------------------------------------------------ front door
     def bucket_for(self, shape: Tuple[int, int, int]) -> Tuple[int, int]:
         """The padded (Hp, Wp) this image shape dispatches at."""
@@ -1673,6 +1858,15 @@ class ServingEngine:
         route to the xl mesh (its replicated weights are the implicit
         model's).
 
+        ``tier="auto"`` (round 24) is the confidence-gated cascade
+        pseudo-tier (requires ``ServeConfig.cascade``): the request runs
+        on the cheap draft tier first and re-runs on the quality tier
+        ONLY when the draft's mean confidence falls below
+        ``cascade_threshold``.  The result's ``tier`` is whichever tier
+        produced the answer, with ``escalated`` / ``draft_tier`` /
+        ``draft_confidence`` provenance; beyond the tiling threshold
+        the gate applies per halo tile.
+
         ``trace_context`` (round 23) is an upstream ``TraceContext``
         decoded from an inbound ``traceparent`` header: the request's
         ``serve.request`` span ADOPTS that trace id and parents to the
@@ -1689,6 +1883,18 @@ class ServingEngine:
                 f"need two same-shape (H, W, 3) images, got {left.shape} "
                 f"vs {right.shape}")
         bucket = self.policy.bucket_for(left.shape[0], left.shape[1])[:2]
+        if tier == "auto":
+            # Confidence-gated cascade (round 24): draft cheap, escalate
+            # only low-confidence answers.  A pseudo-tier like "xl" —
+            # resolved here, never a queue coordinate of its own.
+            if self._cascade_draft is None:
+                raise ValueError(
+                    "tier 'auto' requested but this engine has no "
+                    "cascade (configure ServeConfig.cascade / --cascade "
+                    "with confidence telemetry on)")
+            return self._submit_cascade(left, right, deadline_ms,
+                                        degradable, t_admit, model,
+                                        trace_context=trace_context)
         want_xl = tier == "xl"
         if want_xl and self.xl is None:
             raise ValueError(
@@ -1738,7 +1944,13 @@ class ServingEngine:
         requested_tier = None
         if (self.brownout is not None and degradable
                 and tier not in self.serve_cfg.brownout_exempt_tiers):
-            effective = self.brownout.degrade(tier)
+            # Victim selection (round 24): the tier's recent rolling
+            # mean confidence, when tracked, spares already-struggling
+            # streams from degradation (resilience.degrade).  None
+            # (confidence off) keeps the unconditional ladder.
+            conf = (self.quality.mean_confidence(tier)
+                    if self.quality is not None else None)
+            effective = self.brownout.degrade(tier, confidence=conf)
             if effective != tier:
                 requested_tier, tier = tier, effective
         return tier, requested_tier
@@ -1948,6 +2160,7 @@ class ServingEngine:
             self.metrics.tile_seam_epe.observe(seam)
         iters = [res.iters_used for res in results
                  if res.iters_used is not None]
+        conf_map, conf_mean = self._stitch_confidence(results, specs)
         agg.set_result(ServeResult(
             flow=np.ascontiguousarray(flow),
             queue_wait_s=max(res.queue_wait_s for res in results),
@@ -1961,6 +2174,203 @@ class ServingEngine:
             tiles=len(reqs), seam_epe=seam,
             model=results[0].model,
             model_version=results[0].model_version,
+            confidence=conf_map, confidence_mean=conf_mean,
+            trace_id=results[0].trace_id))
+
+    @staticmethod
+    def _stitch_confidence(results: List["ServeResult"], specs
+                           ) -> Tuple[Optional[np.ndarray],
+                                      Optional[float]]:
+        """Stitch per-tile confidence maps with the same halo-crop
+        geometry as the disparity (confidence and disparity are both
+        (H, W) row fields); (None, None) when confidence is off."""
+        from raft_stereo_tpu.serving import tiles as tiles_mod
+
+        if any(res.confidence is None for res in results):
+            return None, None
+        conf = np.ascontiguousarray(tiles_mod.stitch(
+            [res.confidence for res in results], specs))
+        return conf, float(conf.mean())
+
+    # ------------------------------------------- confidence-gated cascade
+    def _submit_cascade(self, left: np.ndarray, right: np.ndarray,
+                        deadline_ms: Optional[float], degradable: bool,
+                        t_admit: float, model: Optional[str] = None,
+                        trace_context=None) -> Future:
+        """The ``auto`` pseudo-tier: answer on the cheap draft tier
+        first and escalate to the quality tier ONLY when the draft's own
+        confidence map says the answer is doubtful.  Well-textured
+        frames pay draft cost; the hard ones pay draft + quality — mean
+        fleet cost tracks the EASY fraction of traffic instead of the
+        worst case.  Beyond the tiling threshold the gate is per tile:
+        only the doubtful rows of a large frame re-run at quality.
+
+        The draft runs at the ADMITTED draft tier (brownout may degrade
+        it further); escalation re-admits at escalation time so a
+        brownout that deepened mid-request still applies."""
+        tt = self.serve_cfg.tile_threshold_pixels
+        bucket = self.policy.bucket_for(left.shape[0], left.shape[1])[:2]
+        if tt is not None and bucket[0] * bucket[1] > tt:
+            return self._submit_cascade_tiled(
+                left, right, deadline_ms, degradable, t_admit, model,
+                trace_context=trace_context)
+        return self._cascade_one(left, right, deadline_ms, degradable,
+                                 t_admit, model,
+                                 trace_context=trace_context)
+
+    def _cascade_one(self, left: np.ndarray, right: np.ndarray,
+                     deadline_ms: Optional[float], degradable: bool,
+                     t_admit: float, model: Optional[str] = None,
+                     trace_context=None) -> Future:
+        """One draft -> (maybe) escalate chain for a single pair; the
+        returned Future resolves with whichever answer survived, carrying
+        full provenance (``draft_tier``, ``draft_confidence``,
+        ``escalated``)."""
+        draft = self._cascade_draft
+        threshold = self.serve_cfg.cascade_threshold
+        agg: Future = Future()
+        draft_tier, draft_requested = self._admit_tier(draft, degradable)
+        dreq = self._enqueue(left, right, deadline_ms, draft_tier,
+                             draft_requested, t_admit, model=model,
+                             trace_context=trace_context)
+
+        def on_draft(future):
+            exc = future.exception()
+            if exc is not None:
+                agg.set_exception(exc)
+                return
+            res = future.result()
+            conf = res.confidence_mean
+            if conf is None or conf >= threshold:
+                # Confident (or confidence unavailable — fail open to
+                # the draft rather than double every request's cost).
+                res.draft_tier = draft_tier
+                res.draft_confidence = conf
+                res.total_s = time.perf_counter() - t_admit
+                if self._cascade_drafts is not None:
+                    self._cascade_drafts.inc()
+                agg.set_result(res)
+                return
+            if self._cascade_escalations is not None:
+                self._cascade_escalations.inc()
+            try:
+                esc_tier, esc_requested = self._admit_tier(
+                    self._cascade_escalate, degradable)
+                ereq = self._enqueue(left, right, deadline_ms, esc_tier,
+                                     esc_requested, t_admit, model=model,
+                                     trace_context=trace_context)
+            except BaseException as e:  # noqa: BLE001 — typed to caller
+                agg.set_exception(e)
+                return
+
+            def on_escalated(f2):
+                exc2 = f2.exception()
+                if exc2 is not None:
+                    agg.set_exception(exc2)
+                    return
+                res2 = f2.result()
+                res2.escalated = True
+                res2.draft_tier = draft_tier
+                res2.draft_confidence = conf
+                res2.total_s = time.perf_counter() - t_admit
+                agg.set_result(res2)
+
+            ereq.future.add_done_callback(on_escalated)
+
+        dreq.future.add_done_callback(on_draft)
+        return agg
+
+    def _submit_cascade_tiled(self, left: np.ndarray, right: np.ndarray,
+                              deadline_ms: Optional[float],
+                              degradable: bool, t_admit: float,
+                              model: Optional[str] = None,
+                              trace_context=None) -> Future:
+        """Per-tile cascade for beyond-threshold pairs: every halo tile
+        runs its own draft -> escalate chain (``_cascade_one``), so only
+        the low-confidence ROWS of a large frame pay quality-tier cost.
+        Stitching and seam measurement mirror ``_finish_tiled``."""
+        from raft_stereo_tpu.serving import tiles as tiles_mod
+
+        specs = tiles_mod.plan_tiles(left.shape[0],
+                                     self.serve_cfg.tile_rows,
+                                     self.serve_cfg.tile_halo)
+        if len(specs) < 2:
+            return self._cascade_one(left, right, deadline_ms,
+                                     degradable, t_admit, model,
+                                     trace_context=trace_context)
+        futs = [self._cascade_one(
+                    np.ascontiguousarray(left[s.src0:s.src1]),
+                    np.ascontiguousarray(right[s.src0:s.src1]),
+                    deadline_ms, degradable, t_admit, model,
+                    trace_context=trace_context)
+                for s in specs]
+        agg: Future = Future()
+        state = {"remaining": len(futs), "done": False}
+        lock = threading.Lock()
+
+        def on_done(future):
+            action = None
+            with lock:
+                if state["done"]:
+                    return
+                if future.exception() is not None:
+                    state["done"], action = True, "fail"
+                else:
+                    state["remaining"] -= 1
+                    if state["remaining"] == 0:
+                        state["done"], action = True, "finish"
+            if action == "fail":
+                agg.set_exception(future.exception())
+            elif action == "finish":
+                try:
+                    self._finish_cascade_tiled(agg, futs, specs, t_admit)
+                except BaseException as e:  # noqa: BLE001 — typed to caller
+                    agg.set_exception(e)
+
+        for fut in futs:
+            fut.add_done_callback(on_done)
+        return agg
+
+    def _finish_cascade_tiled(self, agg: Future, futs: List[Future],
+                              specs, t_admit: float) -> None:
+        """All per-tile cascades answered: stitch (disparity AND
+        confidence), report the ESCALATED tier when any tile escalated
+        (the cost actually paid), keep per-tile draft provenance in the
+        aggregate's ``draft_confidence`` (worst tile — the gate that
+        mattered)."""
+        from raft_stereo_tpu.serving import tiles as tiles_mod
+
+        results = [f.result() for f in futs]
+        flow = tiles_mod.stitch([res.flow for res in results], specs)
+        seam = tiles_mod.seam_epe([res.flow for res in results], specs)
+        self.metrics.tiled_requests.inc()
+        if seam is not None:
+            self.metrics.tile_seam_epe.observe(seam)
+        iters = [res.iters_used for res in results
+                 if res.iters_used is not None]
+        conf_map, conf_mean = self._stitch_confidence(results, specs)
+        escalated = any(res.escalated for res in results)
+        final = next((res for res in results if res.escalated),
+                     results[0])
+        draft_confs = [res.draft_confidence for res in results
+                       if res.draft_confidence is not None]
+        agg.set_result(ServeResult(
+            flow=np.ascontiguousarray(flow),
+            queue_wait_s=max(res.queue_wait_s for res in results),
+            device_s=max(res.device_s for res in results),
+            fetch_s=max(res.fetch_s for res in results),
+            total_s=time.perf_counter() - t_admit,
+            batch_size=max(res.batch_size for res in results),
+            iters_used=max(iters) if iters else None,
+            tier=final.tier, requested_tier=final.requested_tier,
+            attempts=max(res.attempts for res in results),
+            tiles=len(futs), seam_epe=seam,
+            model=results[0].model,
+            model_version=results[0].model_version,
+            confidence=conf_map, confidence_mean=conf_mean,
+            escalated=escalated,
+            draft_tier=results[0].draft_tier,
+            draft_confidence=min(draft_confs) if draft_confs else None,
             trace_id=results[0].trace_id))
 
     # ---------------------------------------------------- streaming sessions
@@ -2348,7 +2758,8 @@ class ServingEngine:
                     # The hidden tree rides (and drops) with the flow
                     # state: the keyframe guard's flow_low=None above
                     # zeroes both halves inside note_result.
-                    hidden=res.hidden)
+                    hidden=res.hidden,
+                    confidence=res.confidence_mean)
                 self.metrics.observe_session_frame(
                     "warm" if res.warm else "cold")
         finally:
@@ -2544,6 +2955,11 @@ class ServingEngine:
         qmode = bundle.tier_models[cache_tier].config.quant
         if qmode != "off":
             tail += f",quant={qmode}"
+        if self.serve_cfg.confidence:
+            # The confidence variant returns two extra outputs — a
+            # different program, so a different cost record.  Off keeps
+            # every key byte-identical to the round-23 build.
+            tail += ",conf"
         if family is not None:
             tail += f",{family}"
         if bundle.name is not None:
@@ -2599,7 +3015,8 @@ class ServingEngine:
                      else "reuse" if family in _CTX_REUSE_FAMILIES
                      else None),
                 hidden_init=(family in _H_IN_FAMILIES),
-                return_hidden=(family in _H_OUT_FAMILIES))
+                return_hidden=(family in _H_OUT_FAMILIES),
+                return_confidence=self.serve_cfg.confidence)
         if self.disk_cache is not None:
             fwd = self._load_or_compile(fwd, bucket, batch, worker, tier,
                                         family, model)
@@ -2673,6 +3090,12 @@ class ServingEngine:
         if bundle.name is not None:
             extra = {"model": bundle.name,
                      "model_version": bundle.version}
+        if self.serve_cfg.confidence:
+            # Confidence variants return two extra outputs — a distinct
+            # program, so a distinct disk entry.  Joins as an extra
+            # kwarg ONLY when on, so confidence-off keys stay
+            # byte-identical to the round-23 build (the bitwise pin).
+            extra["confidence"] = True
         return executable_cache_key(
             config=bundle.tier_models[cache_tier].config.to_json(),
             bucket=tuple(bucket), batch=int(batch),
@@ -3185,12 +3608,26 @@ class ServingEngine:
                 ctx_out = jtu.tree_map(lambda x: np.asarray(x), ctx_dev)
             if family in _H_OUT_FAMILIES:
                 # The hidden tree rides just before the ctx bundle
-                # (return order: flow_up, flow_low[, iters][, hidden]
-                # [, ctx]) — now the LAST remaining element.
+                # (return order: flow_up, flow_low[, iters][, conf]
+                # [, hidden][, ctx]) — now the LAST remaining element.
                 import jax.tree_util as jtu
                 out, hidden_dev = out[:-1], out[-1]
                 hidden_out = jtu.tree_map(lambda x: np.asarray(x),
                                           hidden_dev)
+            conf_padded = None
+            confidence_on = self.serve_cfg.confidence and not xl
+            if confidence_on:
+                # The confidence element — the model's (conf_low,
+                # conf_up) pair — rides just before hidden/ctx, so after
+                # those peels it is the last remaining element.  Only
+                # the full-res map is served.
+                out, conf_dev = out[:-1], out[-1]
+                conf_padded = np.asarray(conf_dev[1])   # (n, Hp, Wp)
+                if family is FAMILY_BASE and not adaptive:
+                    # The base fixed-depth program returns a bare array
+                    # without confidence; restore that arity for the
+                    # shared unpack below.
+                    out = out[0]
             if family is FAMILY_BASE or xl:
                 if adaptive:
                     flows, iters_used_dev = out
@@ -3291,6 +3728,19 @@ class ServingEngine:
                 import jax.tree_util as jtu
                 hidden_i = jtu.tree_map(lambda leaf, j=i: leaf[j],
                                         hidden_out)
+            conf_i = None
+            conf_mean = None
+            if conf_padded is not None:
+                conf_i = r.payload.padder.unpad(
+                    conf_padded[i][None])[0]
+                if conf_i.dtype != np.float32:
+                    conf_i = conf_i.astype(np.float32)
+                conf_i = np.ascontiguousarray(conf_i)
+                conf_mean = float(conf_i.mean())
+                if self.quality is not None:
+                    self.quality.observe(tier or "default",
+                                         bundle.coord, conf_mean,
+                                         exemplar=exemplar)
             r.future.set_result(ServeResult(
                 flow=np.ascontiguousarray(flow), queue_wait_s=wait,
                 device_s=device_s, fetch_s=fetch_s, total_s=total,
@@ -3311,6 +3761,7 @@ class ServingEngine:
                 warm_hidden=(family in _H_IN_FAMILIES),
                 model=bundle.name,
                 model_version=bundle.version,
+                confidence=conf_i, confidence_mean=conf_mean,
                 trace_id=exemplar))
             if exemplar is not None:
                 self.tracer.add_span("serve.respond", r.trace, p_respond,

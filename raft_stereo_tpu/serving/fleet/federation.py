@@ -19,6 +19,14 @@ Two invariants the design is built around:
   ``stale_after_s`` ages them out entirely — matching how federation
   consumers reason about absent-vs-zero.
 
+Because re-labelling is generic, NEW series federate with zero code
+here: the round-24 quality families (``serve_confidence{tier=,model=}``
+histograms with exemplars, ``serve_quality_good/bad_total``,
+``serve_cascade_*_total``, ``serve_slo_burn_rate{dimension="quality"}``)
+appear in ``/metrics/fleet`` with their ``replica=`` label the moment a
+replica starts exposing them — scripts/quality_smoke.py pins exactly
+that.
+
 Re-labelling is a text transform on the exposition format, not a parse
 into a metric model: each sample line gets ``replica="…"`` spliced into
 its labelset (respecting quotes/escapes — label VALUES may contain

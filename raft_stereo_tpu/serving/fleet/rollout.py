@@ -25,7 +25,11 @@ fire-and-forget — the shadow answer is compared against the primary's
 (mean end-point-error between the two disparity maps), recorded into
 the regression window, and dropped, never returned to the client.
 Shadow EPE is the strongest regression signal: it measures the canary
-against the incumbent on identical live inputs.
+against the incumbent on identical live inputs.  When both arms serve
+with confidence telemetry (round 24, ``--confidence``) the compare also
+diffs the two answers' ``X-Confidence`` headers — a canary that matches
+the incumbent's disparity but is systematically LESS SURE of it is an
+early regression the EPE diff cannot see.
 
 **Auto-demotion** closes the loop with the brownout hysteresis shape
 (serving/resilience.py): a regression signal — canary transport/HTTP
@@ -77,6 +81,14 @@ class RolloutConfig:
     # Canary error-rate (transport + HTTP >= 500) above which the
     # canary is regressing even without shadow evidence.
     error_threshold: float = 0.5
+    # Mean confidence DROP (primary minus canary, from the replicas'
+    # X-Confidence headers on identical inputs) above which the canary
+    # is regressing — the round-24 quality signal: a canary that
+    # answers with the same EPE but systematically less confidence is
+    # drifting toward the failure the drift watchdog pages on.  Only
+    # fed when BOTH arms serve with confidence telemetry; same
+    # window/min_samples/dwell hysteresis as the EPE verdict.
+    confidence_threshold: float = 0.2
     # The hysteresis dwell: the regression verdict must hold
     # continuously this long before demotion fires (brownout pattern —
     # a single bad window never flips the fleet).
@@ -93,6 +105,10 @@ class RolloutConfig:
         if not 0 < self.error_threshold <= 1:
             raise ValueError(
                 f"error_threshold={self.error_threshold} not in (0, 1]")
+        if not 0 < self.confidence_threshold <= 1:
+            raise ValueError(
+                f"confidence_threshold={self.confidence_threshold} "
+                f"not in (0, 1]")
         if self.demote_after_s < 0:
             raise ValueError(
                 f"demote_after_s={self.demote_after_s} must be >= 0")
@@ -123,6 +139,7 @@ class RolloutPolicy:
         # Rolling evidence.
         self._epe_window: Deque[float] = deque(maxlen=cfg.window)
         self._outcome_window: Deque[bool] = deque(maxlen=cfg.window)
+        self._conf_window: Deque[float] = deque(maxlen=cfg.window)
         self._transitions = []
         r = registry or MetricsRegistry()
         self.registry = r
@@ -150,6 +167,10 @@ class RolloutPolicy:
             "fleet_shadow_epe_mean",
             "mean |EPE| divergence between canary and primary answers "
             "over the rolling shadow-compare window")
+        self.shadow_confidence_gauge = r.gauge(
+            "fleet_shadow_confidence_delta_mean",
+            "mean confidence drop (primary minus canary, X-Confidence "
+            "headers) over the rolling shadow-compare window")
 
     # ------------------------------------------------------------- arming
     def set_canary(self, spec: str, fraction: float,
@@ -179,6 +200,7 @@ class RolloutPolicy:
             self._bad_since = None
             self._epe_window.clear()
             self._outcome_window.clear()
+            self._conf_window.clear()
             self._note_event_locked("canary_armed", fraction=fraction,
                                     shadow_fraction=shadow_fraction)
             self.fraction_gauge.set(fraction)
@@ -196,6 +218,7 @@ class RolloutPolicy:
             self._bad_since = None
             self._epe_window.clear()
             self._outcome_window.clear()
+            self._conf_window.clear()
             self._note_event_locked("canary_cleared")
             self.fraction_gauge.set(0)
         return self.status()
@@ -268,6 +291,17 @@ class RolloutPolicy:
             self.shadow_epe_gauge.set(sum(vals) / len(vals))
         self.poll()
 
+    def note_shadow_confidence(self, delta: float) -> None:
+        """One shadow pair's confidence compared: ``delta`` is the
+        primary's mean confidence minus the canary's on the SAME input
+        (positive = the canary is LESS sure of its answer).  Fed by the
+        router only when both arms answered with ``X-Confidence``."""
+        with self._lock:
+            self._conf_window.append(float(delta))
+            vals = list(self._conf_window)
+            self.shadow_confidence_gauge.set(sum(vals) / len(vals))
+        self.poll()
+
     def _regression_locked(self) -> Optional[str]:
         """The current regression verdict, or None: which signal says
         the canary is worse than the incumbent."""
@@ -284,6 +318,12 @@ class RolloutPolicy:
                 return (f"canary error rate {rate:.2f} > "
                         f"{self.cfg.error_threshold} over "
                         f"{len(self._outcome_window)} requests")
+        if len(self._conf_window) >= self.cfg.min_samples:
+            mean_drop = sum(self._conf_window) / len(self._conf_window)
+            if mean_drop > self.cfg.confidence_threshold:
+                return (f"shadow confidence drop {mean_drop:.3f} > "
+                        f"{self.cfg.confidence_threshold} over "
+                        f"{len(self._conf_window)} compares")
         return None
 
     def poll(self) -> bool:
@@ -319,6 +359,7 @@ class RolloutPolicy:
     def status(self) -> Dict[str, object]:
         with self._lock:
             vals = list(self._epe_window)
+            confs = list(self._conf_window)
             return {
                 "model": (f"{self._model[0]}@{self._model[1]}"
                           if self._model else None),
@@ -331,6 +372,9 @@ class RolloutPolicy:
                 "shadow_compares": self.shadow_compares.value,
                 "shadow_epe_mean": (round(sum(vals) / len(vals), 4)
                                     if vals else None),
+                "shadow_confidence_delta_mean": (
+                    round(sum(confs) / len(confs), 4) if confs
+                    else None),
                 "canary_errors": sum(
                     1 for ok in self._outcome_window if not ok),
                 "demotions": self.demotions.value,

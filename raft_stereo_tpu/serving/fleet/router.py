@@ -1136,7 +1136,7 @@ class FleetRouter:
                 # about the weights.
                 self.rollout.note_canary_result(status < 500)
             elif shadow and status == 200:
-                self._mirror_shadow(path_qs, body, headers, payload)
+                self._mirror_shadow(path_qs, body, headers, payload, h)
             return status, h, payload
         if canary is not None:
             # The canary arm never answered at all: transport-level
@@ -1177,21 +1177,26 @@ class FleetRouter:
     # ------------------------------------------------------- shadow mirror
     def _mirror_shadow(self, path_qs: str, body: bytes,
                        headers: Sequence[Tuple[str, str]],
-                       primary_payload: bytes) -> None:
+                       primary_payload: bytes,
+                       primary_headers: Sequence[Tuple[str, str]] = ()
+                       ) -> None:
         """Fire-and-forget mirror of one baseline request to the canary
         version on a short-lived thread: the shadow answer is compared
-        against the primary's disparity (mean EPE divergence), recorded
-        into the rollout policy's regression window, and DROPPED —
-        never returned, never retried, never allowed to fail the
-        client's request."""
+        against the primary's disparity (mean EPE divergence) — and,
+        when both arms answered with ``X-Confidence``, against the
+        primary's confidence (round 24) — recorded into the rollout
+        policy's regression windows, and DROPPED — never returned,
+        never retried, never allowed to fail the client's request."""
         threading.Thread(
             target=self._shadow_once,
-            args=(path_qs, body, list(headers), primary_payload),
+            args=(path_qs, body, list(headers), primary_payload,
+                  list(primary_headers)),
             daemon=True, name="fleet-shadow").start()
 
     def _shadow_once(self, path_qs: str, body: bytes,
                      headers: List[Tuple[str, str]],
-                     primary_payload: bytes) -> None:
+                     primary_payload: bytes,
+                     primary_headers: List[Tuple[str, str]]) -> None:
         try:
             model = self.rollout.canary_model()
             if model is None:
@@ -1200,7 +1205,7 @@ class FleetRouter:
                    if k.lower() != "x-model"]
             fwd.append(("X-Model", model[0]))
             rep = self.pick_stateless()
-            status, _h, payload = rep.forward(
+            status, h, payload = rep.forward(
                 "POST", path_qs, body, fwd, self.cfg.request_timeout_s)
             if status != 200:
                 self.rollout.note_canary_result(status < 500)
@@ -1208,10 +1213,35 @@ class FleetRouter:
             epe = self._payload_epe(primary_payload, payload)
             if epe is not None:
                 self.rollout.note_shadow_epe(epe)
+            delta = self._confidence_delta(primary_headers, h)
+            if delta is not None:
+                self.rollout.note_shadow_confidence(delta)
         except (ReplicaUnreachable, NoReplicasAvailable):
             pass        # no capacity for shadows is not canary evidence
         except Exception:  # pragma: no cover — mirror must never raise
             log.exception("shadow mirror failed")
+
+    @staticmethod
+    def _confidence_delta(primary_headers: Sequence[Tuple[str, str]],
+                          shadow_headers: Sequence[Tuple[str, str]]
+                          ) -> Optional[float]:
+        """Primary minus canary mean confidence from the two responses'
+        ``X-Confidence`` headers (positive = the canary is less sure);
+        None unless BOTH arms served with confidence telemetry — absent
+        headers are not evidence."""
+        def _conf(hs):
+            for k, v in hs:
+                if k.lower() == "x-confidence":
+                    try:
+                        return float(v)
+                    except ValueError:
+                        return None
+            return None
+
+        a, b = _conf(primary_headers), _conf(shadow_headers)
+        if a is None or b is None:
+            return None
+        return a - b
 
     @staticmethod
     def _payload_epe(primary: bytes, shadow: bytes) -> Optional[float]:

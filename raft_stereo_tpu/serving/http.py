@@ -17,7 +17,14 @@ Endpoints:
     - ``npy`` (default): raw ``.npy`` float32 positive-disparity map;
     - ``png``: 16-bit PNG, disparity*256 (the KITTI on-disk convention —
       data/frame_utils.write_disp_kitti reads it back losslessly to
-      1/256 px).
+      1/256 px);
+    - ``npz`` (round 24): an ``np.savez`` archive with ``disparity``
+      (float32) plus — when the engine serves with confidence telemetry
+      (``--confidence``) — the full-resolution per-pixel ``confidence``
+      map (float32 in (0, 1]);
+    - ``conf_png``: the CONFIDENCE map alone as an 8-bit PNG
+      (confidence*255) — the quick-look heat map; 400 when the result
+      carries no confidence.
   Errors map to transport codes with TYPED JSON bodies so clients can
   machine-react: 429 (queue full) and 503 (draining) both carry
   ``{"error": "overloaded", "retry_after_s": N}`` plus the matching
@@ -28,6 +35,13 @@ Endpoints:
   Under brownout degradation a response served at a cheaper tier than
   requested carries ``X-Degraded: <requested>-><served>``; the
   ``X-No-Degrade`` request header opts one request out.
+  Quality observability (round 24, ``--confidence``): every response
+  carries ``X-Confidence`` (the answer's mean per-pixel confidence,
+  4 decimals).  ``?tier=auto`` rides the confidence-gated cascade
+  (``--cascade``): the draft tier answers first and only low-confidence
+  requests re-run on the quality tier — responses carry
+  ``X-Escalated: 0|1``, ``X-Draft-Tier``, and (escalated)
+  ``X-Draft-Confidence``; 400 without a cascade configured.
 * ``POST /v1/stream/<session-id>`` — one FRAME of a streaming stereo
   session (warm-start video serving, serving/sessions.py).  Body,
   content types, ``?tier=`` / ``X-Tier``, ``X-Deadline-Ms``, and the
@@ -49,6 +63,11 @@ Endpoints:
   lifetime stats (frames, warm/cold split, scene cuts, mean GRU
   iterations), 404 on an unknown id, 410 on an already-dead one.
 * ``GET /metrics`` — Prometheus text exposition (serving/metrics.py).
+* ``GET /quality`` — online quality posture (round 24): per-tier rolling
+  mean confidence, good/bad totals vs the floor, the PSI drift
+  watchdog's state, the quality SLO burn, and the cascade's
+  draft/escalation split; 404 unless the engine serves with
+  ``--confidence`` (the off wire surface is unchanged).
 * ``GET /healthz`` — LIVENESS: one JSON line (status, queue depth,
   inflight count, last-batch age, device count, readiness) answered
   whenever the process and its queue exist.  A restart-looping load
@@ -149,7 +168,9 @@ def _decode_pair(body: bytes, content_type: str
         return z["left"], z["right"]
 
 
-def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
+def _encode_disparity(disp: np.ndarray, fmt: str,
+                      confidence: Optional[np.ndarray] = None
+                      ) -> Tuple[bytes, str]:
     if fmt == "npy":
         buf = io.BytesIO()
         np.save(buf, disp.astype(np.float32))
@@ -161,7 +182,28 @@ def _encode_disparity(disp: np.ndarray, fmt: str) -> Tuple[bytes, str]:
         buf = io.BytesIO()
         Image.fromarray(enc).save(buf, format="PNG")
         return buf.getvalue(), "image/png"
-    raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+    if fmt == "npz":
+        # Disparity + (confidence on) the full-res per-pixel confidence
+        # map in one archive — the "answer with its error bars" payload.
+        arrays = {"disparity": disp.astype(np.float32)}
+        if confidence is not None:
+            arrays["confidence"] = confidence.astype(np.float32)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue(), "application/x-npz"
+    if fmt == "conf_png":
+        from PIL import Image
+
+        if confidence is None:
+            raise ValueError(
+                "format=conf_png: this result carries no confidence map "
+                "(serve with --confidence; xl-tier results have none)")
+        enc = np.clip(confidence * 255.0, 0, 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(enc).save(buf, format="PNG")
+        return buf.getvalue(), "image/png"
+    raise ValueError(f"format={fmt!r}: use 'npy', 'png', 'npz' or "
+                     f"'conf_png'")
 
 
 def _stream_session_id(path: str, headers) -> Optional[str]:
@@ -219,6 +261,16 @@ def make_handler(service: StereoService,
             if path == "/metrics":
                 self._reply(200, service.metrics.render_text().encode(),
                             "text/plain; version=0.0.4")
+            elif path == "/quality":
+                # Online quality posture (round 24); 404 with confidence
+                # off so the off wire surface stays unchanged.
+                q = service.quality_status()
+                if q is None:
+                    self._reply_json(404, {
+                        "error": "quality telemetry off (start "
+                                 "raft-serve with --confidence)"})
+                else:
+                    self._reply_json(200, q)
             elif path == "/healthz":
                 # Liveness: answers as long as the process is up; the
                 # readiness decision lives on /readyz (split so a warm
@@ -419,8 +471,9 @@ def make_handler(service: StereoService,
                     float(deadline_hdr) if deadline_hdr else None)
                 query = parse_qs(url.query)
                 fmt = query.get("format", ["npy"])[0]
-                if fmt not in ("npy", "png"):
-                    raise ValueError(f"format={fmt!r}: use 'npy' or 'png'")
+                if fmt not in ("npy", "png", "npz", "conf_png"):
+                    raise ValueError(f"format={fmt!r}: use 'npy', 'png', "
+                                     f"'npz' or 'conf_png'")
                 tier = query.get("tier", [None])[0] or \
                     self.headers.get("X-Tier")
                 if tier == "xl":
@@ -439,6 +492,23 @@ def make_handler(service: StereoService,
                             "single-device — the warm/ctx state "
                             "machinery does not compose with the "
                             "mesh-sharded program")
+                elif tier == "auto":
+                    # The confidence-gated cascade pseudo-tier (round
+                    # 24): valid only on an engine with a cascade
+                    # configured; the engine re-raises ValueError
+                    # (-> 400) at submit, this check just answers with
+                    # the actionable message before reading weights.
+                    if getattr(service, "_cascade_draft", None) is None:
+                        raise ValueError(
+                            "tier 'auto': this server has no confidence "
+                            "cascade (start raft-serve with --confidence "
+                            "--cascade)")
+                    if session_id is not None:
+                        raise ValueError(
+                            "tier 'auto': streaming sessions pin one "
+                            "compiled family per stream — the cascade's "
+                            "draft/escalate re-run does not compose "
+                            "with warm session state")
                 elif tier is not None:
                     service.resolve_tier(tier)  # 400 on unknown tiers
                 # ``?model=`` / ``X-Model`` picks a REGISTERED model
@@ -525,7 +595,14 @@ def make_handler(service: StereoService,
                 log.exception("inference failed")
                 self._reply_json(500, {"error": str(e)})
                 return
-            payload, ctype = _encode_disparity(result.disparity, fmt)
+            try:
+                payload, ctype = _encode_disparity(
+                    result.disparity, fmt, confidence=result.confidence)
+            except ValueError as e:
+                # conf_png on a result without a confidence map (xl
+                # tier, or a confidence-off engine): client error.
+                self._reply_json(400, {"error": str(e)})
+                return
             headers = [
                 ("X-Queue-Wait-Ms", f"{result.queue_wait_s * 1e3:.2f}"),
                 ("X-Device-Ms", f"{result.device_s * 1e3:.2f}"),
@@ -551,6 +628,18 @@ def make_handler(service: StereoService,
             if result.degraded:
                 headers.append(("X-Degraded",
                                 f"{result.requested_tier}->{result.tier}"))
+            if result.confidence_mean is not None:
+                headers.append(("X-Confidence",
+                                f"{result.confidence_mean:.4f}"))
+            if result.draft_tier is not None:
+                # Cascade (?tier=auto) provenance: which tier drafted,
+                # whether the draft's confidence forced the re-run.
+                headers.append(("X-Escalated",
+                                "1" if result.escalated else "0"))
+                headers.append(("X-Draft-Tier", result.draft_tier))
+                if result.draft_confidence is not None:
+                    headers.append(("X-Draft-Confidence",
+                                    f"{result.draft_confidence:.4f}"))
             if result.model is not None:
                 # Named-model responses carry the exact version that
                 # served them — the canary comparator keys on this.

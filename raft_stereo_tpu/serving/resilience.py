@@ -227,12 +227,31 @@ class BrownoutController:
     def max_level(self) -> int:
         return max(0, len(self.ladder) - 1)
 
-    def degrade(self, tier: Optional[str]) -> Optional[str]:
+    # Mean request confidence BELOW which a request is spared from
+    # degradation (round 24 quality observability): brownout exists to
+    # shed compute from requests that can afford it, and a
+    # low-confidence stream is exactly the one that cannot — pushing it
+    # down the ladder converts a latency problem into a quality
+    # incident.  The engine feeds the requester's recent rolling mean
+    # confidence (telemetry/quality.QualityTracker) when available.
+    spare_below: float = 0.0
+
+    def degrade(self, tier: Optional[str],
+                confidence: Optional[float] = None) -> Optional[str]:
         """The tier a request actually runs at the current level: its
         requested tier pushed ``level`` rungs toward the cheap end of the
-        ladder.  Tiers off the ladder (and None) pass through."""
+        ladder.  Tiers off the ladder (and None) pass through.
+
+        ``confidence`` is the principled victim-selection signal: when
+        given and below ``spare_below``, the request passes through
+        undegraded — recent answers at its tier were already
+        low-confidence, so it NEEDS the expensive program.  None (the
+        default, and always when confidence telemetry is off) keeps the
+        round-13 ladder behavior byte-for-byte."""
         lvl = self.level
         if lvl == 0 or tier is None or tier not in self.ladder:
+            return tier
+        if confidence is not None and confidence < self.spare_below:
             return tier
         idx = self.ladder.index(tier)
         return self.ladder[max(0, idx - lvl)]

@@ -350,6 +350,15 @@ class StereoSession:
     scene_cuts: int = 0
     iters_used_sum: int = 0
     iters_used_frames: int = 0
+    # Per-frame mean confidence accumulation (round 24 quality
+    # observability; fed only when the engine serves with
+    # ``ServeConfig.confidence``): the close stats report the stream's
+    # lifetime mean and its last frame — the per-stream "was this stream
+    # healthy" answer.  Advisory telemetry: deliberately NOT in the
+    # handoff record (an imported stream restarts its quality history).
+    confidence_sum: float = 0.0
+    confidence_frames: int = 0
+    confidence_last: Optional[float] = None
     # Frame-ordering lock (see module docstring): held from submit until
     # the frame's future resolves, so one session never has two frames
     # in flight and a dispatch cycle can never reorder them.
@@ -360,7 +369,8 @@ class StereoSession:
                     thumb: Optional[np.ndarray],
                     bucket: Tuple[int, int], raw_shape: Tuple[int, int],
                     warm: bool, iters_used: Optional[int],
-                    hidden: Optional[object] = None) -> None:
+                    hidden: Optional[object] = None,
+                    confidence: Optional[float] = None) -> None:
         """Fold one completed frame into the state (called by the engine
         while ``order_lock`` is held, so no torn reads are possible).
         ``flow_low=None`` drops the warm-start state — the engine's
@@ -381,6 +391,10 @@ class StereoSession:
         if iters_used is not None:
             self.iters_used_sum += int(iters_used)
             self.iters_used_frames += 1
+        if confidence is not None:
+            self.confidence_sum += float(confidence)
+            self.confidence_frames += 1
+            self.confidence_last = float(confidence)
 
     def to_record(self) -> Tuple[Dict[str, object], Dict[str, object]]:
         """``(meta, arrays)`` snapshot for the handoff blob.  The caller
@@ -425,8 +439,15 @@ class StereoSession:
             return None
         return self.iters_used_sum / self.iters_used_frames
 
+    def confidence_mean(self) -> Optional[float]:
+        """Lifetime mean per-frame confidence; None unless the engine
+        served this stream with confidence telemetry on."""
+        if not self.confidence_frames:
+            return None
+        return self.confidence_sum / self.confidence_frames
+
     def stats(self) -> Dict[str, object]:
-        return {
+        out = {
             "session_id": self.session_id,
             **({"model": self.model} if self.model is not None else {}),
             "frames": self.frame_index,
@@ -438,6 +459,12 @@ class StereoSession:
                                 if self.iters_used_mean() is not None
                                 else None),
         }
+        if self.confidence_frames:
+            # Only when fed: confidence-off close stats stay
+            # byte-identical to the round-23 payload.
+            out["confidence_mean"] = round(self.confidence_mean(), 4)
+            out["confidence_last"] = round(self.confidence_last, 4)
+        return out
 
 
 class SessionStore:

@@ -62,7 +62,8 @@ class BurnRateTracker:
                  windows: Sequence[Tuple[str, float]] = DEFAULT_WINDOWS,
                  registry=None,
                  gauge_name: str = "fleet_slo_burn_rate",
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 dimension: Optional[str] = None):
         if not 0.0 < availability < 1.0:
             raise ValueError(f"availability={availability} must be in "
                              f"(0, 1) — 1.0 leaves no error budget to "
@@ -71,6 +72,14 @@ class BurnRateTracker:
             raise ValueError("need at least one burn-rate window")
         self.availability = float(availability)
         self.latency_ms = latency_ms
+        # SLO dimension this tracker burns against: None (the round-23
+        # availability/latency accounting — gauge labels unchanged,
+        # byte-for-byte) or a named dimension like "quality" (the
+        # confidence-floor budget; telemetry/quality.py feeds its
+        # good/bad totals).  Joins the gauge labels and the status
+        # payload so one registry can carry several budgets side by
+        # side.
+        self.dimension = dimension
         self.windows: Tuple[Tuple[str, float], ...] = tuple(
             (str(label), float(seconds)) for label, seconds in windows)
         self.budget = 1.0 - self.availability
@@ -86,11 +95,14 @@ class BurnRateTracker:
         self._gauges = {}
         if registry is not None:
             for label, _seconds in self.windows:
+                labels = {"window": label}
+                if dimension is not None:
+                    labels["dimension"] = dimension
                 self._gauges[label] = registry.gauge(
                     gauge_name,
                     "SLO error-budget burn rate over a trailing window "
                     "(1.0 = spending exactly the budget)",
-                    labels={"window": label})
+                    labels=labels)
 
     def sample(self, good_total: float, bad_total: float
                ) -> Dict[str, float]:
@@ -129,7 +141,7 @@ class BurnRateTracker:
 
     def status(self) -> Dict[str, object]:
         with self._lock:
-            return {
+            out = {
                 "availability_objective": self.availability,
                 "latency_objective_ms": self.latency_ms,
                 "error_budget": self.budget,
@@ -138,6 +150,9 @@ class BurnRateTracker:
                 "burn_rates": dict(self._burns),
                 "samples": len(self._samples),
             }
+            if self.dimension is not None:
+                out["dimension"] = self.dimension
+            return out
 
 
 class SloWatchdog:
@@ -201,6 +216,8 @@ class SloWatchdog:
             "availability_objective": self.tracker.availability,
             "latency_objective_ms": self.tracker.latency_ms,
         }
+        if self.tracker.dimension is not None:
+            detail["dimension"] = self.tracker.dimension
         if self.sink is not None:
             self.sink.fire("slo_burn", **detail)
         if self.dump_fn is not None:

@@ -1,0 +1,452 @@
+#!/usr/bin/env python
+"""CI quality-observability smoke: confidence maps, the cascade tier,
+quality SLO series, and the drift watchdog — hermetic on CPU.
+
+The round-24 acceptance properties, proven over REAL HTTP on one
+in-process replica behind the fleet router:
+
+1. **Confidence-gated cascade** — a brief-trained tiny model serves
+   ``?tier=auto``: a hard high-frequency-noise request drafts cheap,
+   comes back doubtful, and ESCALATES (``X-Escalated: 1`` with
+   ``X-Draft-Tier`` / ``X-Draft-Confidence`` provenance); a flat
+   textureless request resolves at the draft tier (``X-Escalated: 0``).
+   ``format=conf_png`` ships the confidence map alone as a PNG.
+2. **Quality series** — ``/metrics`` exposes the full confidence
+   family: ``serve_confidence`` histograms, ``serve_quality_good/
+   bad_total`` vs the floor, ``serve_cascade_draft/escalated_total``,
+   and the quality-dimension SLO burn
+   (``serve_slo_burn_rate{dimension="quality"}``).
+3. **Fleet visibility** — the SAME series re-exposed by the router's
+   ``/metrics/fleet`` under the replica label, so a fleet operator
+   sees per-replica quality posture behind one scrape.
+4. **Drift → ONE bundle** — a perturbed checkpoint (the published
+   ``pert@v1``) takes live traffic via ``?model=``; the confidence
+   distribution shifts, the PSI watchdog fires a typed
+   ``quality_drift`` anomaly (run-event + ``serve_anomalies_total``),
+   and EXACTLY ONE flight-recorder bundle lands — the detector latches,
+   so continued degraded traffic does not produce a firehose.  The
+   anomaly counter is visible in ``/metrics/fleet`` under the
+   offending replica's label.
+
+The cascade threshold is not guessed: the smoke pre-measures the draft
+-depth confidence of both probes through ``make_forward`` and splits
+them at the midpoint, so the escalate/stay asserts hold whenever the
+confidence signal discriminates at all (its real contract).
+
+Writes ``bench_record`` JSON to QUALITY_OUT (default QUALITY_ci.json;
+CI uploads it).  Exit 0 on success, non-zero with a diagnostic.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python scripts/quality_smoke.py
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from _hermetic import force_cpu  # noqa: E402
+
+force_cpu(1)
+
+HW = (64, 96)                       # /32-aligned: no padder in the way
+TRAIN_STEPS = int(os.environ.get("QUALITY_SMOKE_STEPS", "120"))
+TRAIN_ITERS = 6
+SERVE_ITERS = 8
+DRAFT_SPEC = "draft:0.25:2"
+REFERENCE_N = 40                    # drift reference freeze point
+DRIFT_BUDGET = 96                   # max degraded requests before giving up
+OUT = os.environ.get("QUALITY_OUT", "QUALITY_ci.json")
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _post(url, data, headers=None, timeout=300):
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _npz(left, right):
+    buf = io.BytesIO()
+    import numpy as np
+
+    np.savez(buf, left=left, right=right)
+    return buf.getvalue()
+
+
+def _noise_pair(seed=3):
+    """The HARD probe: high-frequency random noise — far outside the
+    smooth-texture training distribution, so the draft stays doubtful."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, HW + (3,), dtype=np.uint8)
+    return left, np.roll(left, -4, axis=1)
+
+
+def _flat_pair():
+    """The EASY probe: zero texture — the refinement loop has nothing to
+    move and converges immediately (confidence ~1)."""
+    import numpy as np
+
+    g = np.full(HW + (3,), 127, np.uint8)
+    return g, g.copy()
+
+
+def _scene_pairs(n=8):
+    """In-distribution traffic: the exact warped-texture scenes the model
+    brief-trained on (tests/golden_data.py recipe)."""
+    import numpy as np
+
+    from golden_data import disparity_field, textured_image, warp_right
+
+    h, w = HW
+    rng = np.random.default_rng(97)
+    pairs = []
+    for _ in range(n):
+        left = textured_image(rng, h, w)
+        disp = disparity_field(rng, h, w)
+        right = warp_right(left, disp)
+        pairs.append((left.astype(np.uint8), right.astype(np.uint8)))
+    return pairs
+
+
+def _quality(base):
+    _, _, b = _get(f"{base}/quality")
+    return json.loads(b)
+
+
+def _bundles(fr_dir):
+    if not os.path.isdir(fr_dir):
+        return []
+    return sorted(d for d in os.listdir(fr_dir)
+                  if os.path.isdir(os.path.join(fr_dir, d)))
+
+
+def premeasure_threshold(cfg, variables):
+    """Split point between the two probes' draft-depth confidences."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    fwd = make_forward(RAFTStereo(cfg), iters=2, donate_images=False,
+                      return_confidence=True)
+
+    def conf_of(pair):
+        left, right = pair
+        out = fwd(variables, jnp.asarray(left[None], jnp.float32),
+                  jnp.asarray(right[None], jnp.float32))
+        _conf_low, conf_up = out[-1]
+        return float(np.asarray(conf_up).mean())
+
+    conf_noise = conf_of(_noise_pair())
+    conf_flat = conf_of(_flat_pair())
+    assert conf_flat > conf_noise, (
+        f"confidence must discriminate: flat {conf_flat:.3f} <= "
+        f"noise {conf_noise:.3f} — the convergence signal is broken")
+    thr = min(0.95, max(0.05, 0.5 * (conf_flat + conf_noise)))
+    print(f"[quality_smoke] draft confidence: noise {conf_noise:.3f}, "
+          f"flat {conf_flat:.3f} -> cascade threshold {thr:.3f}",
+          flush=True)
+    return thr, conf_noise, conf_flat
+
+
+def publish_perturbed(cfg, variables, workdir, store):
+    """Perturb the trained weights and publish them as ``pert@v1`` — the
+    degraded checkpoint the drift leg routes live traffic onto."""
+    import jax
+    import numpy as np
+
+    from raft_stereo_tpu.training import checkpoint as ckpt_mod
+    import publish_model
+
+    rng = np.random.default_rng(17)
+
+    def _perturb(leaf):
+        a = np.asarray(leaf)
+        if a.dtype.kind != "f" or a.size == 0:
+            return leaf
+        scale = 0.5 * (a.std() or 1.0)
+        return a + rng.normal(0.0, scale, a.shape).astype(a.dtype)
+
+    pert = jax.tree_util.tree_map(_perturb, variables)
+    state = {"params": pert["params"]}
+    if "batch_stats" in pert:
+        state["batch_stats"] = pert["batch_stats"]
+    ckpt = os.path.join(workdir, "ckpt-pert")
+    ckpt_mod.save_checkpoint(ckpt, cfg, state)
+    rc = publish_model.main(["--restore_ckpt", ckpt, "--store", store,
+                             "--name", "pert", "--version", "v1",
+                             "--verify"])
+    assert rc == 0, "publishing pert@v1 failed"
+    return "pert"
+
+
+def cascade_leg(base) -> dict:
+    """Property 1: auto escalates the doubtful request, spares the easy
+    one, and conf_png ships the confidence map."""
+    noise = _npz(*_noise_pair())
+    flat = _npz(*_flat_pair())
+    ct = {"Content-Type": "application/x-npz"}
+
+    status, hdr, _ = _post(f"{base}/v1/disparity?tier=auto", noise, ct)
+    assert status == 200, f"auto noise probe: HTTP {status}"
+    assert hdr.get("X-Escalated") == "1", (
+        f"hard request must escalate: X-Escalated={hdr.get('X-Escalated')}"
+        f" conf={hdr.get('X-Confidence')}")
+    assert hdr.get("X-Draft-Tier") == "draft", hdr.get("X-Draft-Tier")
+    assert "X-Draft-Confidence" in hdr, "escalation must carry provenance"
+    assert hdr.get("X-Tier") in (None, "quality") or True
+    noise_rec = {"escalated": True,
+                 "draft_confidence": float(hdr["X-Draft-Confidence"]),
+                 "final_confidence": float(hdr["X-Confidence"])}
+
+    status, hdr, _ = _post(f"{base}/v1/disparity?tier=auto", flat, ct)
+    assert status == 200, f"auto flat probe: HTTP {status}"
+    assert hdr.get("X-Escalated") == "0", (
+        f"flat request must resolve at the draft: "
+        f"X-Escalated={hdr.get('X-Escalated')} "
+        f"conf={hdr.get('X-Confidence')}")
+    flat_rec = {"escalated": False,
+                "confidence": float(hdr["X-Confidence"])}
+
+    status, hdr, body = _post(
+        f"{base}/v1/disparity?tier=auto&format=conf_png", noise, ct)
+    assert status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n", (
+        f"conf_png: HTTP {status}, magic {body[:8]!r}")
+
+    rec = {"noise": noise_rec, "flat": flat_rec, "conf_png_bytes": len(body)}
+    print(f"[quality_smoke] cascade: {rec}", flush=True)
+    return rec
+
+
+QUALITY_FAMILIES = ("serve_confidence_bucket", "serve_quality_good_total",
+                    "serve_cascade_draft_total",
+                    "serve_cascade_escalated_total")
+
+
+def metrics_leg(base) -> dict:
+    """Property 2: the confidence family renders on the replica scrape."""
+    _, _, b = _get(f"{base}/metrics")
+    text = b.decode()
+    for fam in QUALITY_FAMILIES:
+        assert fam in text, f"/metrics missing {fam}"
+    assert re.search(r'serve_slo_burn_rate{[^}]*dimension="quality"', text), \
+        "/metrics missing the quality-dimension SLO burn gauge"
+    drafts = sum(float(m) for m in re.findall(
+        r"^serve_cascade_draft_total(?:{[^}]*})?\s+([0-9.eE+-]+)$",
+        text, re.M))
+    escalated = sum(float(m) for m in re.findall(
+        r"^serve_cascade_escalated_total(?:{[^}]*})?\s+([0-9.eE+-]+)$",
+        text, re.M))
+    # Three auto probes so far: noise (escalated), flat (draft alone),
+    # noise/conf_png (escalated).  Drafts counts draft-ALONE answers.
+    assert drafts >= 1 and escalated >= 2, (drafts, escalated)
+    rec = {"cascade_drafts": drafts, "cascade_escalated": escalated}
+    print(f"[quality_smoke] /metrics quality families present: {rec}",
+          flush=True)
+    return rec
+
+
+def fleet_leg(router_base) -> dict:
+    """Property 3: one federated scrape, quality series replica-labelled."""
+    _, _, b = _get(f"{router_base}/metrics/fleet")
+    text = b.decode()
+    assert 'fleet_federation_up{replica="r0"} 1' in text, \
+        "replica r0 missing from federation"
+    assert re.search(r'serve_confidence_bucket{[^}]*replica="r0"', text), \
+        "serve_confidence not re-exposed under the replica label"
+    assert re.search(r'serve_quality_good_total{[^}]*replica="r0"', text), \
+        "quality totals not re-exposed under the replica label"
+    print("[quality_smoke] /metrics/fleet re-exposes the quality series "
+          "under replica=\"r0\": OK", flush=True)
+    return {"replica_labelled": True}
+
+
+def drift_leg(base, router, router_base, fr_dir, events_path) -> dict:
+    """Property 4: perturbed checkpoint under live traffic -> typed
+    quality_drift anomaly, EXACTLY ONE flight-recorder bundle, visible
+    in the fleet scrape under the replica label."""
+    ct = {"Content-Type": "application/x-npz"}
+    payloads = [_npz(l, r) for l, r in _scene_pairs()]
+
+    # Freeze the reference on healthy traffic (the probes above already
+    # contributed a handful of observations).
+    i = 0
+    while True:
+        q = _quality(base)
+        if q["drift"]["reference_n"] >= REFERENCE_N:
+            break
+        assert i < REFERENCE_N + 16, \
+            f"reference never froze: {q['drift']}"
+        status, _, _ = _post(f"{base}/v1/disparity?tier=quality",
+                             payloads[i % len(payloads)], ct)
+        assert status == 200
+        i += 1
+    healthy_mean = _quality(base)["drift"]
+    print(f"[quality_smoke] drift reference frozen after {i} healthy "
+          f"requests: {healthy_mean}", flush=True)
+    assert _bundles(fr_dir) == [], \
+        f"no bundle may exist before the drift: {_bundles(fr_dir)}"
+
+    # Degraded checkpoint takes the SAME traffic.
+    fired_at = None
+    for j in range(DRIFT_BUDGET):
+        status, hdr, _ = _post(
+            f"{base}/v1/disparity?tier=quality&model=pert",
+            payloads[j % len(payloads)], ct)
+        assert status == 200, f"degraded request {j}: HTTP {status}"
+        if _quality(base)["drift"]["tripped"]:
+            fired_at = j + 1
+            break
+    q = _quality(base)
+    assert fired_at is not None, (
+        f"drift watchdog never fired after {DRIFT_BUDGET} degraded "
+        f"requests: {q['drift']}")
+    print(f"[quality_smoke] quality_drift fired after {fired_at} degraded "
+          f"requests: {q['drift']}", flush=True)
+
+    # Exactly ONE bundle — and the latch holds it at one.
+    bundles = _bundles(fr_dir)
+    assert len(bundles) == 1, f"expected exactly one bundle: {bundles}"
+    for j in range(8):
+        status, _, _ = _post(
+            f"{base}/v1/disparity?tier=quality&model=pert",
+            payloads[j % len(payloads)], ct)
+        assert status == 200
+    assert _bundles(fr_dir) == bundles, (
+        f"latched detector must not refire: {_bundles(fr_dir)}")
+
+    # The typed run event, exactly once, with the PSI that tripped it.
+    with open(events_path) as f:
+        anomalies = [json.loads(ln) for ln in f
+                     if '"anomaly"' in ln]
+    anomalies = [r for r in anomalies if r.get("event") == "anomaly"]
+    drift_events = [r for r in anomalies
+                    if r.get("kind") == "quality_drift"]
+    assert len(drift_events) == 1, (
+        f"exactly one typed quality_drift event expected: "
+        f"{[r.get('kind') for r in anomalies]}")
+    ev = drift_events[0]
+    assert ev["psi"] >= ev["threshold"], ev
+    assert ev.get("bundle"), "the anomaly event must link its bundle"
+
+    # Fleet visibility: the anomaly counter under the replica label.
+    router.federator.scrape_once()
+    _, _, b = _get(f"{router_base}/metrics/fleet")
+    text = b.decode()
+    m = re.search(
+        r'serve_anomalies_total{[^}]*replica="r0"[^}]*}\s+([0-9.eE+-]+)',
+        text)
+    assert m and float(m.group(1)) >= 1, \
+        "anomaly not visible in /metrics/fleet under replica=\"r0\""
+
+    rec = {"reference_requests": i, "fired_after": fired_at,
+           "psi": ev["psi"], "threshold": ev["threshold"],
+           "bundle": os.path.basename(ev["bundle"]),
+           "bundles_total": len(bundles),
+           "fleet_anomalies": float(m.group(1))}
+    print(f"[quality_smoke] drift leg: {rec}", flush=True)
+    return rec
+
+
+def main() -> int:
+    t0 = time.time()
+    import numpy as np  # noqa: F401  (asserts numpy import works early)
+
+    from early_exit_report import model_config, trained_variables
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+    from raft_stereo_tpu.serving.fleet import (FleetRouter, RouterConfig,
+                                               RouterHTTPServer)
+    from raft_stereo_tpu.serving.http import StereoHTTPServer
+    from raft_stereo_tpu.telemetry.events import EventLog, write_record
+    from raft_stereo_tpu.telemetry.flight_recorder import FlightRecorder
+    from raft_stereo_tpu.telemetry.watchdog import AnomalySink
+
+    workdir = tempfile.mkdtemp(prefix="quality-smoke-")
+    record = {"metric": "quality_smoke", "train_steps": TRAIN_STEPS,
+              "hw": list(HW)}
+    try:
+        cfg = model_config()
+        variables = trained_variables(cfg, TRAIN_STEPS, HW, TRAIN_ITERS)
+        thr, conf_noise, conf_flat = premeasure_threshold(cfg, variables)
+        record["threshold"] = {"cascade_threshold": thr,
+                               "draft_conf_noise": conf_noise,
+                               "draft_conf_flat": conf_flat}
+
+        store = os.path.join(workdir, "store")
+        publish_perturbed(cfg, variables, workdir, store)
+
+        sc = ServeConfig(
+            max_batch=1, batch_sizes=(1,), iters=SERVE_ITERS,
+            tiers=(DRAFT_SPEC, "quality"),
+            confidence=True, cascade=True,
+            cascade_draft="draft", cascade_escalate="quality",
+            cascade_threshold=thr,
+            quality_drift_reference=REFERENCE_N,
+            quality_drift_window=48,
+            model_store_dir=store, models=("pert@v1",))
+        fr_dir = os.path.join(workdir, "flight")
+        events_path = os.path.join(workdir, "events.jsonl")
+        events = EventLog(events_path)
+        with StereoService(cfg, variables, sc) as svc:
+            recorder = FlightRecorder(fr_dir, tracer=svc.tracer,
+                                      registry=svc.metrics.registry)
+            sink = AnomalySink(events, recorder,
+                               counter=svc.metrics.anomalies)
+            svc.attach_anomaly_sink(sink)
+            server = StereoHTTPServer(svc, port=0,
+                                      recorder=recorder).start()
+            router = FleetRouter(
+                {"r0": server.url},
+                RouterConfig(health_poll_s=0.2, health_timeout_s=5.0,
+                             request_timeout_s=300.0,
+                             fleet_brownout=False)).start()
+            rserver = RouterHTTPServer(router, port=0).start()
+            try:
+                svc.prewarm(HW)
+                base = server.url
+                record["cascade"] = cascade_leg(base)
+                record["metrics"] = metrics_leg(base)
+                router.federator.scrape_once()
+                record["fleet"] = fleet_leg(rserver.url)
+                record["drift"] = drift_leg(base, router, rserver.url,
+                                            fr_dir, events_path)
+            finally:
+                rserver.shutdown()
+                router.stop()
+                server.shutdown()
+        events.close()
+        record["wall_s"] = round(time.time() - t0, 1)
+        write_record(OUT, record, indent=2)
+        print(f"[quality_smoke] PASS in {record['wall_s']}s -> {OUT}",
+              flush=True)
+        return 0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,453 @@
+"""Round-24 quality observability: per-request confidence maps, the
+confidence-OFF bitwise pin, the confidence-gated cascade tier, and the
+online quality trackers.
+
+The contracts pinned here (ISSUE round 24):
+
+* OFF pin — ``return_confidence`` defaulted/False lowers EVERY program
+  family (base, early-exit, state, warm, warm+hidden, ctx save/reuse)
+  to byte-identical StableHLO: the flag off is unobservable, down to
+  the compiled program.  The engine's cost and persist keys gain the
+  ``,conf`` coordinate ONLY when ``ServeConfig.confidence`` is on.
+* signal semantics — confidence is a convergence statement: a flat
+  textureless pair (updates stall instantly) is confident, a
+  high-frequency noise pair (correlation never locks) is doubtful, and
+  turning the map on never changes the flow bytes.
+* cascade — ``tier="auto"`` drafts cheap, escalates only the doubtful
+  answer, and stamps the provenance (draft tier + draft confidence) on
+  the result; without the cascade configured "auto" is a typed error.
+* trackers — the PSI drift watchdog fires ONCE per excursion (latched,
+  re-arms on recovery), the quality tracker feeds the SLO/registry,
+  brownout spares low-confidence requests, and a sustained shadow
+  confidence drop demotes a canary under the same hysteresis as EPE.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+TINY = dict(hidden_dims=(32, 32, 32), fnet_dim=64, corr_backend="reg")
+ITERS = 4
+HW = (48, 64)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+
+    cfg = RaftStereoConfig(**TINY)
+    model = RAFTStereo(cfg)
+    dummy = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, dummy, iters=1,
+                           test_mode=True)
+    return cfg, variables
+
+
+def _pair(seed=3, textured=True):
+    if not textured:   # zero texture: updates stall, confidence ~ 1
+        left = np.full(HW + (3,), 127, np.uint8)
+        return left, left.copy()
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, HW + (3,), dtype=np.uint8)
+    return left, np.roll(left, -3, axis=1)
+
+
+def _as_batch(*imgs):
+    return jnp.asarray(np.stack(imgs).astype(np.float32))
+
+
+# ------------------------------------------------------------- model level
+def test_model_confidence_tuple_and_flow_bitwise_unchanged(tiny_model):
+    """``return_confidence=True`` appends one (conf_low, conf_up) element
+    and changes NOTHING else: disparity and flow stay bitwise-equal to
+    the plain call."""
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(RaftStereoConfig(**TINY))
+    i1, i2 = map(_as_batch, _pair())
+    d0, f0 = model.apply(variables, i1, i2, iters=ITERS, test_mode=True)
+    d1, f1, conf = model.apply(variables, i1, i2, iters=ITERS,
+                               test_mode=True, return_confidence=True)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(f0), np.asarray(f1))
+    conf_low, conf_up = conf
+    assert conf_up.shape == (1,) + HW
+    c = np.asarray(conf_up)
+    assert np.all(c > 0.0) and np.all(c <= 1.0)
+    assert conf_low.ndim == 3 and conf_low.shape[0] == 1
+
+
+def test_model_confidence_is_test_mode_only(tiny_model):
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(RaftStereoConfig(**TINY))
+    i1, i2 = map(_as_batch, _pair())
+    with pytest.raises(ValueError, match="test-mode"):
+        model.apply(variables, i1, i2, iters=2, test_mode=False,
+                    return_confidence=True)
+
+
+# -------------------------------------------------- the OFF program pin
+def _families(cfg):
+    """Every make_forward program family and its extra lowering avals."""
+    f = cfg.downsample_factor
+    low = jax.ShapeDtypeStruct((1, HW[0] // f, HW[1] // f), jnp.float32)
+    return {
+        "base": ({}, ()),
+        "state": ({"return_state": True}, ()),
+        "warm": ({"warm_start": True}, (low,)),
+        "warm_hidden": ({"warm_start": True, "return_hidden": True},
+                        (low,)),
+        "ctx_save": ({"return_state": True, "ctx": "save"}, ()),
+    }
+
+
+@pytest.mark.parametrize("family", ["base", "state", "warm",
+                                    "warm_hidden", "ctx_save"])
+def test_conf_off_program_byte_identical_per_family(tiny_model, family):
+    """The pin: with the flag off (default OR explicit False) every
+    family lowers to byte-identical StableHLO — and ON is a genuinely
+    different program (the extra confidence output)."""
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(RaftStereoConfig(**TINY))
+    kwargs, extra = _families(cfg)[family]
+    img = jax.ShapeDtypeStruct((1,) + HW + (3,), jnp.float32)
+
+    def lower_text(**kw):
+        fwd = make_forward(model, iters=ITERS, donate_images=False,
+                           **kwargs, **kw)
+        return fwd.lower(variables, img, img, *extra).as_text()
+
+    t_default = lower_text()
+    t_off = lower_text(return_confidence=False)
+    t_on = lower_text(return_confidence=True)
+    assert t_default == t_off, (
+        f"{family}: return_confidence=False must lower the DEFAULT "
+        f"program byte-for-byte")
+    assert t_on != t_off, (
+        f"{family}: the confidence variant must be a distinct program")
+
+
+def test_conf_off_program_byte_identical_ctx_reuse(tiny_model):
+    """ctx='reuse' takes the context bundle as a traced INPUT; its avals
+    come from eval_shape of the save program (no compile)."""
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(RaftStereoConfig(**TINY))
+    img = jax.ShapeDtypeStruct((1,) + HW + (3,), jnp.float32)
+    save = make_forward(model, iters=ITERS, donate_images=False,
+                        return_state=True, ctx="save")
+    bundle_avals = jax.eval_shape(save, variables, img, img)[-1]
+
+    def lower_text(**kw):
+        fwd = make_forward(model, iters=ITERS, donate_images=False,
+                           return_state=True, ctx="reuse", **kw)
+        return fwd.lower(variables, img, img, bundle_avals).as_text()
+
+    assert lower_text() == lower_text(return_confidence=False)
+    assert lower_text(return_confidence=True) != lower_text()
+
+
+def test_conf_off_early_exit_program_byte_identical(tiny_model):
+    """The while-loop (early-exit) family holds the same pin."""
+    import dataclasses
+
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    ee_cfg = dataclasses.replace(RaftStereoConfig(**TINY),
+                                 exit_threshold_px=0.05,
+                                 exit_min_iters=1)
+    model = RAFTStereo(ee_cfg)
+    img = jax.ShapeDtypeStruct((1,) + HW + (3,), jnp.float32)
+
+    def lower_text(**kw):
+        fwd = make_forward(model, iters=ITERS, donate_images=False, **kw)
+        return fwd.lower(variables, img, img).as_text()
+
+    assert lower_text() == lower_text(return_confidence=False)
+    assert lower_text(return_confidence=True) != lower_text()
+
+
+# ------------------------------------------------------- signal semantics
+def test_flat_ranks_above_noise(tiny_model):
+    """Confidence is a convergence statement: the textureless pair's
+    updates stall sooner than high-frequency noise's, so it RANKS more
+    confident — at any depth.  (Absolute calibration needs trained
+    weights; tools/confidence_report.py and scripts/quality_smoke.py
+    measure it.  Random-init weights keep every update large, so both
+    values are small — the ordering is the invariant.)"""
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+
+    cfg, variables = tiny_model
+    model = RAFTStereo(RaftStereoConfig(**TINY))
+    fwd = make_forward(model, iters=2, donate_images=False,
+                       return_confidence=True)
+
+    def conf_mean(pair):
+        l, r = pair
+        out = fwd(variables, _as_batch(l), _as_batch(r))
+        _conf_low, conf_up = out[-1]
+        c = np.asarray(conf_up)
+        assert np.all(c > 0.0) and np.all(c <= 1.0)
+        return float(c.mean())
+
+    c_flat = conf_mean(_pair(textured=False))
+    c_noise = conf_mean(_pair(textured=True))
+    assert c_flat > c_noise, (c_flat, c_noise)
+
+
+# ------------------------------------------------------------ engine level
+def test_engine_confidence_off_result_and_keys_unchanged(tiny_model):
+    """``confidence=False`` keeps the round-23 surface byte-for-byte:
+    no confidence fields on the result, no ``,conf`` coordinate in the
+    cost key, the identical disk key — and ``tier="auto"`` is a typed
+    refusal without the cascade."""
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    l, r = _pair()
+    with StereoService(cfg, variables,
+                       ServeConfig(max_batch=1, batch_sizes=(1,),
+                                   iters=ITERS)) as svc:
+        res = svc.infer(l, r, timeout=300)
+        assert res.confidence is None and res.confidence_mean is None
+        assert res.escalated is False and res.draft_tier is None
+        key = svc._cost_key((64, 64), 1)
+        assert "conf" not in key
+        assert svc.quality is None and svc.quality_status() is None
+        with pytest.raises(ValueError, match="cascade"):
+            svc.infer(l, r, tier="auto", timeout=10)
+
+
+def test_engine_cascade_escalates_doubtful_spares_easy(tiny_model):
+    """tier="auto": noise drafts cheap, comes back doubtful, escalates
+    (provenance stamped); flat resolves at the draft.  Confidence ON
+    never changes the flow bytes, and the key space gains ``,conf``.
+
+    The gate threshold is pre-measured (scripts/quality_smoke.py's
+    protocol): random-init weights keep absolute confidence low
+    everywhere, so the test splits the two probes' measured draft-depth
+    confidences at the midpoint instead of assuming a calibrated 0.5."""
+    from raft_stereo_tpu.eval.runner import make_forward
+    from raft_stereo_tpu.models.raft_stereo import RAFTStereo
+    from raft_stereo_tpu.config import RaftStereoConfig
+    from raft_stereo_tpu.serving import ServeConfig, StereoService
+
+    cfg, variables = tiny_model
+    # 64x64 inputs: the dispatch bucket exactly, so the probe and the
+    # engine run the same pixels (no padder in between).
+    rng = np.random.default_rng(3)
+    noise_l = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    noise_r = np.roll(noise_l, -3, axis=1)
+    flat_l = np.full((64, 64, 3), 127, np.uint8)
+    flat_r = flat_l.copy()
+
+    probe = make_forward(RAFTStereo(RaftStereoConfig(**TINY)),
+                         iters=ITERS, donate_images=False,
+                         return_confidence=True)
+
+    def conf_mean(l, r):
+        out = probe(variables, _as_batch(l), _as_batch(r))
+        return float(np.asarray(out[-1][1]).mean())
+
+    c_noise = conf_mean(noise_l, noise_r)
+    c_flat = conf_mean(flat_l, flat_r)
+    assert c_flat > c_noise, (c_flat, c_noise)
+    thr = 0.5 * (c_flat + c_noise)
+
+    sc = ServeConfig(max_batch=1, batch_sizes=(1,), iters=ITERS,
+                     tiers=("draft:0.25:2", "quality"),
+                     confidence=True, cascade=True,
+                     cascade_draft="draft", cascade_escalate="quality",
+                     cascade_threshold=thr)
+    with StereoService(cfg, variables, sc) as svc:
+        hard = svc.infer(noise_l, noise_r, tier="auto", timeout=300)
+        assert hard.escalated is True
+        assert hard.tier == "quality" and hard.draft_tier == "draft"
+        assert hard.draft_confidence is not None
+        assert hard.draft_confidence < thr
+        assert hard.confidence.shape == (64, 64)
+        assert hard.confidence.dtype == np.float32
+        assert 0.0 < hard.confidence_mean <= 1.0
+
+        easy = svc.infer(flat_l, flat_r, tier="auto", timeout=300)
+        assert easy.escalated is False
+        assert easy.tier == "draft" and easy.draft_tier == "draft"
+        assert easy.confidence_mean > thr
+
+        # conf ON does not move the flow: the quality tier's answer is
+        # bitwise what the same tier returns on this engine directly.
+        direct = svc.infer(noise_l, noise_r, tier="quality", timeout=300)
+        np.testing.assert_array_equal(hard.flow, direct.flow)
+
+        # Drafts counts draft-ALONE answers; escalated requests bump
+        # only the escalation counter (engine semantics).
+        assert svc._cascade_drafts.value == 1
+        assert svc._cascade_escalations.value == 1
+        key = svc._cost_key((64, 64), 1, tier="quality")
+        assert ",conf" in key
+
+        q = svc.quality_status()
+        assert q is not None and q["cascade"]["drafts"] == 1
+        assert q["cascade"]["escalated"] == 1
+        assert q["good"] + q["bad"] >= 3
+        text = svc.metrics.registry.render_text()
+        assert "serve_confidence_bucket" in text
+        assert 'dimension="quality"' in text
+
+
+def test_serve_config_cascade_validation():
+    from raft_stereo_tpu.serving import ServeConfig
+
+    with pytest.raises(ValueError, match="confidence"):
+        ServeConfig(cascade=True, tiers=("interactive", "quality"),
+                    cascade_draft="interactive",
+                    cascade_escalate="quality")
+    with pytest.raises(ValueError):
+        ServeConfig(confidence=True, cascade=True,
+                    tiers=("interactive", "quality"),
+                    cascade_draft="nope", cascade_escalate="quality")
+    with pytest.raises(ValueError):
+        ServeConfig(confidence=True, confidence_floor=1.5)
+
+
+# --------------------------------------------------------------- trackers
+def _drift(**kw):
+    from raft_stereo_tpu.telemetry.quality import QualityDriftWatchdog
+
+    class Sink:
+        def __init__(self):
+            self.fired = []
+
+        def fire(self, kind, **detail):
+            self.fired.append((kind, detail))
+            return {"kind": kind, **detail}
+
+    sink = Sink()
+    kw.setdefault("threshold", 0.25)
+    kw.setdefault("reference_size", 40)
+    kw.setdefault("window", 32)
+    return QualityDriftWatchdog(sink=sink, **kw), sink
+
+
+def test_drift_watchdog_fires_once_latched_then_rearms():
+    wd, sink = _drift()
+    # Deterministic value cycles: identical healthy traffic before and
+    # after the excursion, so recovery's PSI is exactly the no-drift
+    # floor (a noisy random stream at these small test windows has a
+    # PSI noise floor above the threshold — production uses 256/128).
+    healthy_vals = (0.82, 0.85, 0.88, 0.91)
+    degraded_vals = (0.18, 0.22, 0.27, 0.31)
+    healthy = lambda i: healthy_vals[i % len(healthy_vals)]
+    degraded = lambda i: degraded_vals[i % len(degraded_vals)]
+    for i in range(40):                       # freeze the reference
+        wd.observe(healthy(i))
+    assert wd.status()["reference_n"] == 40
+    for i in range(64):                       # the excursion
+        wd.observe(degraded(i))
+    assert len(sink.fired) == 1, "latched: one excursion, ONE anomaly"
+    kind, detail = sink.fired[0]
+    assert kind == "quality_drift"
+    assert detail["psi"] >= detail["threshold"]
+    assert wd.status()["tripped"] is True
+    for i in range(96):                       # recovery re-arms ...
+        wd.observe(healthy(i))
+    assert wd.status()["tripped"] is False
+    for i in range(64):                       # ... and a NEW excursion fires
+        wd.observe(degraded(i))
+    assert len(sink.fired) == 2
+
+
+def test_quality_tracker_totals_slo_and_rolling_mean():
+    from raft_stereo_tpu.telemetry.quality import QualityTracker
+    from raft_stereo_tpu.telemetry.registry import MetricsRegistry
+    from raft_stereo_tpu.telemetry.slo import BurnRateTracker
+
+    reg = MetricsRegistry()
+    slo = BurnRateTracker(availability=0.9, registry=reg,
+                          gauge_name="serve_slo_burn_rate",
+                          dimension="quality")
+    qt = QualityTracker(registry=reg, floor=0.5, slo=slo, slo_every=2)
+    for c in (0.9, 0.8, 0.3, 0.95):
+        qt.observe("quality", None, c)
+    good, bad = qt.totals()
+    assert (good, bad) == (3, 1)
+    assert qt.mean_confidence("quality") == pytest.approx(
+        (0.9 + 0.8 + 0.3 + 0.95) / 4)
+    st = qt.status()
+    assert st["good"] == 3 and st["bad"] == 1
+    assert "slo" in st and "drift" in st
+    text = reg.render_text()
+    assert "serve_confidence_bucket" in text
+    assert 'serve_slo_burn_rate{' in text and 'dimension="quality"' in text
+    with pytest.raises(ValueError):
+        QualityTracker(floor=1.5)
+
+
+def test_brownout_spares_low_confidence_requests():
+    """Victim selection: under degradation a LOW-confidence request keeps
+    its tier (it needs the compute); confident traffic steps down."""
+    from raft_stereo_tpu.serving.metrics import ServingMetrics
+    from raft_stereo_tpu.serving.resilience import BrownoutController
+
+    bc = BrownoutController(ServingMetrics(), max_queue=8,
+                            ladder=("interactive", "balanced", "quality"))
+    bc.spare_below = 0.4
+    bc.set_floor(1)
+    assert bc.degrade("quality", confidence=0.9) == "balanced"
+    assert bc.degrade("quality", confidence=None) == "balanced"
+    assert bc.degrade("quality", confidence=0.3) == "quality"
+    bc.spare_below = 0.0        # telemetry off: round-13 behavior
+    assert bc.degrade("quality", confidence=0.3) == "balanced"
+
+
+def test_rollout_shadow_confidence_drop_demotes():
+    """A canary that answers systematically LESS confident than the
+    primary demotes under the same dwell hysteresis as shadow EPE."""
+    from raft_stereo_tpu.serving.fleet.rollout import (RolloutConfig,
+                                                       RolloutPolicy)
+
+    clock = {"t": 0.0}
+    policy = RolloutPolicy(
+        RolloutConfig(min_samples=4, confidence_threshold=0.2,
+                      demote_after_s=1.0),
+        clock=lambda: clock["t"])
+    policy.set_canary("tiny@v2", 0.3, shadow_fraction=0.5)
+    for _ in range(4):
+        policy.note_shadow_confidence(0.45)   # primary 0.45 more sure
+    assert not policy.status()["demoted"], "dwell must gate the demotion"
+    clock["t"] = 2.0
+    policy.note_shadow_confidence(0.45)
+    st = policy.status()
+    assert st["demoted"] is True
+    assert "confidence" in (st["demoted_reason"] or "")
+    assert policy.assign(b"any-request") is None
+
+    # Healthy deltas never demote: the verdict needs a sustained drop.
+    policy2 = RolloutPolicy(
+        RolloutConfig(min_samples=4, confidence_threshold=0.2,
+                      demote_after_s=0.0),
+        clock=lambda: clock["t"])
+    policy2.set_canary("tiny@v2", 0.3)
+    for _ in range(16):
+        policy2.note_shadow_confidence(0.02)
+    assert not policy2.status()["demoted"]

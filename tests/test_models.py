@@ -222,6 +222,19 @@ def test_keys_never_collide_across_coordinates(tiny_model,
         svc.register_model("tiny@v2", prewarm=False)
         assert svc._disk_key(b, 1, 0, None, model="tiny") != v1_disk
         assert svc._cost_key(b, 1, model="tiny") != v1_cost
+        off_cost = svc._cost_key(b, 1)
+        off_disk = svc._disk_key(b, 1, 0, None)
+    # Confidence (r24) is one more key coordinate: the same build with
+    # --confidence compiles a DISTINCT program family, so both keys must
+    # move — and with it off they must not mention it at all.
+    assert ",conf" not in off_cost
+    with StereoService(cfg, variables, ServeConfig(
+            max_batch=2, iters=ITERS,
+            tiers=("interactive", "quality"),
+            confidence=True)) as conf_svc:
+        conf_cost = conf_svc._cost_key(b, 1)
+        assert conf_cost != off_cost and ",conf" in conf_cost
+        assert conf_svc._disk_key(b, 1, 0, None) != off_disk
 
 
 # ----------------------------------------------------- engine registration

@@ -474,6 +474,16 @@ def test_engine_warm_families_join_prewarm_and_ready(tiny_model):
         keys = {svc._disk_key((64, 64), 1, 0, None, fam)
                 for fam in (FAMILY_BASE, FAMILY_STATE, FAMILY_WARM)}
         assert len(keys) == 3
+        # r24: confidence is one more key coordinate — every family's
+        # persist key moves when it is on, and none mention it when off.
+        with StereoService(cfg, variables, ServeConfig(
+                max_batch=1, batch_sizes=(1,), iters=ITERS,
+                sessions=True, warmup_shapes=((48, 64),),
+                prewarm_on_init=False, confidence=True)) as conf_svc:
+            conf_keys = {conf_svc._disk_key((64, 64), 1, 0, None, fam)
+                         for fam in (FAMILY_BASE, FAMILY_STATE,
+                                     FAMILY_WARM)}
+            assert len(conf_keys) == 3 and not (conf_keys & keys)
         # prewarmed programs serve immediately (no first-request compile
         # for any family): a session's first two frames exercise state +
         # warm

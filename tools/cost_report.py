@@ -94,6 +94,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "— the honest flops numerator for serve_mfu/"
                         "train_mfu when the loop exits early (a fixed-"
                         "depth numerator would overstate utilization)")
+    p.add_argument("--metrics_text", default=None,
+                   help="saved GET /metrics (or /metrics/fleet) "
+                        "exposition: parse the per-tier "
+                        "infer_gru_iters_used histograms (sum/count -> "
+                        "mean trip count per dispatch; federated "
+                        "replica= labels aggregate) into a PER-TIER "
+                        "'effective' section — a single --observed_iters "
+                        "scalar goes stale when tiers run different "
+                        "depths (early exit, cascade draft vs escalate). "
+                        "When --observed_iters is absent the scalar "
+                        "section uses the dispatch-weighted mean across "
+                        "tiers.  Also honored by --compiles_json to "
+                        "attach observed means to the per-tier "
+                        "executable groups")
     p.add_argument("--tag", default=DEFAULT_TAG,
                    help="suffix of the default output file name")
     p.add_argument("--out", default=None,
@@ -109,6 +123,112 @@ def build_parser() -> argparse.ArgumentParser:
                         "seconds, flops.  The implicit model groups "
                         "under '(implicit)'")
     return p
+
+
+def _parse_labels(labelset: str) -> Dict[str, str]:
+    """``{a="b",c="d"}`` -> dict, quote/escape-aware: label VALUES may
+    legally contain commas, braces, and escaped quotes, so a naive
+    ``split(",")`` mis-parses federated series (replica names are
+    arbitrary strings)."""
+    out: Dict[str, str] = {}
+    body = labelset.strip()
+    if body.startswith("{"):
+        body = body[1:]
+    if body.endswith("}"):
+        body = body[:-1]
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0:
+            break
+        name = body[i:eq].strip().lstrip(",").strip()
+        j = eq + 1
+        if j >= n or body[j] != '"':
+            break
+        j += 1
+        val = []
+        while j < n:
+            ch = body[j]
+            if ch == "\\" and j + 1 < n:
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(
+                    body[j + 1], body[j + 1]))
+                j += 2
+                continue
+            if ch == '"':
+                break
+            val.append(ch)
+            j += 1
+        if name:
+            out[name] = "".join(val)
+        i = j + 1
+    return out
+
+
+def parse_iters_used_means(text: str) -> Dict[str, Dict[str, float]]:
+    """Per-tier observed GRU trip-count means from a Prometheus
+    exposition: pair the ``infer_gru_iters_used_sum{tier=...}`` /
+    ``_count{tier=...}`` samples the serving engine exports per
+    dispatch.  Federated text (``/metrics/fleet``) carries an extra
+    ``replica=`` label — sums and counts accumulate across replicas, so
+    the mean is dispatch-weighted over the whole fleet.  Returns
+    ``{tier: {"mean": float, "dispatches": float}}``."""
+    sums: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        for prefix, dest in (("infer_gru_iters_used_sum{", sums),
+                             ("infer_gru_iters_used_count{", counts)):
+            if not line.startswith(prefix):
+                continue
+            end = line.rfind("}")
+            if end < 0:
+                continue
+            tier = _parse_labels(line[len(prefix) - 1:end + 1]).get("tier")
+            if tier is None:
+                continue
+            try:
+                val = float(line[end + 1:].split()[0])
+            except (ValueError, IndexError):
+                continue
+            dest[tier] = dest.get(tier, 0.0) + val
+    out: Dict[str, Dict[str, float]] = {}
+    for tier, count in counts.items():
+        if count > 0 and tier in sums:
+            out[tier] = {"mean": sums[tier] / count, "dispatches": count}
+    return out
+
+
+def load_tier_means(path: str) -> Dict[str, Dict[str, float]]:
+    with open(path) as f:
+        tier_means = parse_iters_used_means(f.read())
+    if not tier_means:
+        print(f"[cost_report] WARN: no infer_gru_iters_used series with "
+              f"a tier label in {path}", flush=True)
+    return tier_means
+
+
+def compiles_by_tier(payload: Dict) -> Dict[str, Dict]:
+    """Group a /debug/compiles payload's executables by the ``tier=``
+    coordinate embedded in their cost keys (serving/engine.py
+    ``_cost_key``; non-serving executables and the default tier group
+    under "(none)"): the per-tier compile inventory ``--metrics_text``
+    joins observed iteration means onto."""
+    import re
+    groups: Dict[str, Dict] = {}
+    for rec in payload.get("executables") or ():
+        key = str(rec.get("key") or "")
+        m = re.search(r"[,(]tier=([^,)]+)", key)
+        tier = m.group(1) if m else "(none)"
+        g = groups.setdefault(tier, {
+            "executables": 0, "compile_s": 0.0, "flops": 0.0})
+        g["executables"] += 1
+        g["compile_s"] += float(rec.get("compile_s") or 0.0)
+        g["flops"] += float(rec.get("flops") or 0.0)
+    for g in groups.values():
+        g["compile_s"] = round(g["compile_s"], 4)
+    return groups
 
 
 def compiles_by_model(payload: Dict) -> Dict[str, Dict]:
@@ -138,10 +258,18 @@ def run_compiles_report(args) -> int:
     with open(args.compiles_json) as f:
         payload = json.load(f)
     groups = compiles_by_model(payload)
+    tiers = compiles_by_tier(payload)
+    if args.metrics_text:
+        for tier, obs in load_tier_means(args.metrics_text).items():
+            g = tiers.setdefault(tier, {
+                "executables": 0, "compile_s": 0.0, "flops": 0.0})
+            g["observed_iters_mean"] = round(obs["mean"], 4)
+            g["dispatches"] = int(obs["dispatches"])
     rec = {
         "metric": "compiles_by_model",
         "source": os.path.abspath(args.compiles_json),
         "models": groups,
+        "tiers": tiers,
         "total_executables": payload.get("count"),
         "total_compile_s": payload.get("total_compile_s"),
     }
@@ -415,13 +543,22 @@ def main(argv=None) -> int:
     # cap, so MFU numerators must scale with it or they overstate
     # utilization exactly when the gate saves the most work.
     effective = None
-    if args.observed_iters is not None:
+    tier_means = (load_tier_means(args.metrics_text)
+                  if args.metrics_text else {})
+    observed_scalar = args.observed_iters
+    if observed_scalar is None and tier_means:
+        # No explicit scalar: the dispatch-weighted mean across tiers is
+        # the fleet-honest aggregate depth.
+        disp = sum(t["dispatches"] for t in tier_means.values())
+        observed_scalar = sum(t["mean"] * t["dispatches"]
+                              for t in tier_means.values()) / disp
+    if observed_scalar is not None:
         per_it = per_iter.get("flops")
         fixed_fl = fixed.get("flops")
         if per_it is not None and fixed_fl is not None:
-            eff_flops = fixed_fl + per_it * args.observed_iters
+            eff_flops = fixed_fl + per_it * observed_scalar
             effective = {
-                "observed_iters": args.observed_iters,
+                "observed_iters": round(observed_scalar, 4),
                 "configured_iters": args.iters,
                 "effective_model_flops": eff_flops,
                 "flops_scale_vs_configured": (
@@ -431,8 +568,27 @@ def main(argv=None) -> int:
                         "flops x observed_iters; use as the serve_mfu/"
                         "train_mfu numerator under early exit",
             }
+            if tier_means:
+                # Per-tier honest numerators: tiers run DIFFERENT depths
+                # (early exit converges shallower on easy tiers; the
+                # cascade's draft tier exits earliest), so one scalar
+                # either flatters the deep tier or slanders the shallow
+                # one.  serve_mfu per tier = effective_model_flops[tier]
+                # x dispatch rate / peak.
+                effective["source"] = os.path.abspath(args.metrics_text)
+                effective["per_tier"] = {
+                    tier: {
+                        "observed_iters_mean": round(t["mean"], 4),
+                        "dispatches": int(t["dispatches"]),
+                        "effective_model_flops": (
+                            fixed_fl + per_it * t["mean"]),
+                        "flops_scale_vs_configured": (
+                            round((fixed_fl + per_it * t["mean"])
+                                  / model_flops, 4)
+                            if model_flops else None),
+                    } for tier, t in sorted(tier_means.items())}
             phases["gru_iter"]["flops_at_observed_iters"] = (
-                per_it * args.observed_iters)
+                per_it * observed_scalar)
 
     rec = {
         "metric": "cost_report",
